@@ -1,0 +1,9 @@
+# PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
+# python interpreter otherwise performs at startup (sitecustomize) — tests
+# run CPU-only and must not contend for the single tunneled chip.
+.PHONY: test bench
+test:
+	PALLAS_AXON_POOL_IPS= python -m pytest tests/ -x -q
+
+bench:
+	python bench.py

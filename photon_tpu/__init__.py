@@ -1,0 +1,20 @@
+"""photon-tpu: a TPU-native federated LLM pre-training framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of the reference
+federated-pretraining framework (relogu/photon): a central server aggregates
+model deltas from many "clients", each of which trains a decoder-only LM on
+its own data shard for a number of local steps per round.
+
+Architecture (TPU-first, not a port):
+
+- A *client* is a TPU slice driven by one jitted train step over a
+  ``jax.sharding.Mesh`` (axes: data / fsdp / tensor / sequence), not a gang
+  of per-GPU worker processes (reference: ``photon/worker/worker.py``).
+- Intra-client collectives ride ICI via GSPMD/pjit; cross-client aggregation
+  is a streaming weighted average on host or a DCN collective
+  (reference: NCCL + shm/S3/Ray planes, ``photon/server/s3_utils.py``).
+- Attention is a blockwise Pallas flash-attention kernel tiled for the MXU
+  (reference: CUDA flash-attention).
+"""
+
+__version__ = "0.1.0"

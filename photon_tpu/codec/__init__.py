@@ -1,0 +1,7 @@
+from photon_tpu.codec.params import (  # noqa: F401
+    ParamsMetadata,
+    flatten_params,
+    params_from_ndarrays,
+    params_to_ndarrays,
+    unflatten_params,
+)

@@ -1,0 +1,161 @@
+"""Parameter pytree ⇄ flat ndarray-list codec.
+
+This is the framework-wide contract for moving model weights between the
+training step, the aggregation strategies, the shared-memory plane, the
+object store, and checkpoints — the analog of the reference's
+``ModelParametersMetadata`` (``photon/shm/utils.py:138-247``): a flat list of
+numpy arrays plus (names, shapes, dtypes, byte-bounds) metadata, in a
+deterministic order.
+
+Ordering: sorted flattened pytree paths ("/"-joined), which is stable across
+processes and JAX versions (``jax.tree_util.tree_flatten_with_path`` order is
+deterministic, but we sort explicitly so the order survives pytree-structure
+refactors and matches name-keyed checkpoints).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Iterable
+
+import jax
+import numpy as np
+
+
+def _path_str(path: tuple) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamsMetadata:
+    """Shapes/dtypes/bounds of the flat parameter list.
+
+    ``bounds[i]`` is the byte offset one past array ``i`` inside a single
+    contiguous buffer (reference: ``ModelParametersMetadata.from_ndarrays``,
+    ``shm/utils.py:165-247``) — used by the shm plane for zero-copy maps.
+    """
+
+    names: tuple[str, ...]
+    shapes: tuple[tuple[int, ...], ...]
+    dtypes: tuple[str, ...]
+
+    @property
+    def nbytes_each(self) -> list[int]:
+        return [
+            int(np.prod(s, dtype=np.int64)) * np.dtype(d).itemsize
+            for s, d in zip(self.shapes, self.dtypes)
+        ]
+
+    @property
+    def bounds(self) -> list[int]:
+        out, acc = [], 0
+        for n in self.nbytes_each:
+            acc += n
+            out.append(acc)
+        return out
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self.nbytes_each)
+
+    @property
+    def n_arrays(self) -> int:
+        return len(self.names)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "names": list(self.names),
+                "shapes": [list(s) for s in self.shapes],
+                "dtypes": list(self.dtypes),
+            }
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "ParamsMetadata":
+        d = json.loads(s)
+        return cls(
+            names=tuple(d["names"]),
+            shapes=tuple(tuple(s) for s in d["shapes"]),
+            dtypes=tuple(d["dtypes"]),
+        )
+
+    @classmethod
+    def from_ndarrays(cls, names: Iterable[str], arrays: Iterable[np.ndarray]) -> "ParamsMetadata":
+        names = tuple(names)
+        arrays = list(arrays)
+        return cls(
+            names=names,
+            shapes=tuple(tuple(a.shape) for a in arrays),
+            dtypes=tuple(str(a.dtype) for a in arrays),
+        )
+
+    def validate_arrays(self, arrays: list[np.ndarray]) -> None:
+        if len(arrays) != self.n_arrays:
+            raise ValueError(f"expected {self.n_arrays} arrays, got {len(arrays)}")
+        for name, shape, dtype, a in zip(self.names, self.shapes, self.dtypes, arrays):
+            if tuple(a.shape) != shape or str(a.dtype) != dtype:
+                raise ValueError(
+                    f"array {name!r}: expected {shape}/{dtype}, got {tuple(a.shape)}/{a.dtype}"
+                )
+
+
+def flatten_params(params: Any) -> tuple[list[str], list[Any]]:
+    """Flatten a pytree into (sorted names, leaves in that order)."""
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    named = sorted(((_path_str(path), leaf) for path, leaf in leaves), key=lambda t: t[0])
+    names = [n for n, _ in named]
+    if len(set(names)) != len(names):
+        raise ValueError("duplicate parameter paths after flattening")
+    return names, [leaf for _, leaf in named]
+
+
+def params_to_ndarrays(params: Any) -> tuple[ParamsMetadata, list[np.ndarray]]:
+    """Pytree → (metadata, list of host numpy arrays) in canonical order."""
+    names, leaves = flatten_params(params)
+    # one device_get over the list overlaps all D2H copies (this runs on full
+    # model weights every round — the shm/objstore/checkpoint hot path)
+    arrays = [np.asarray(a) for a in jax.device_get(leaves)]
+    return ParamsMetadata.from_ndarrays(names, arrays), arrays
+
+
+def unflatten_params(template: Any, arrays: list[Any]) -> Any:
+    """Inverse of :func:`flatten_params` given a structural template pytree."""
+    leaves = jax.tree_util.tree_flatten_with_path(template)
+    paths = [(_path_str(path), i) for i, (path, _) in enumerate(leaves[0])]
+    order = sorted(range(len(paths)), key=lambda i: paths[i][0])
+    if len(order) != len(arrays):
+        raise ValueError(f"template has {len(order)} leaves, got {len(arrays)} arrays")
+    new_leaves: list[Any] = [None] * len(order)
+    for canonical_pos, leaf_idx in enumerate(order):
+        new_leaves[leaf_idx] = arrays[canonical_pos]
+    return jax.tree_util.tree_unflatten(leaves[1], new_leaves)
+
+
+def params_from_ndarrays(template: Any, metadata: ParamsMetadata, arrays: list[np.ndarray]) -> Any:
+    """(metadata, arrays) → pytree shaped like ``template``, with validation
+    (reference analog: ``parameters_checker`` asserts, ``photon/utils.py:147-224``)."""
+    metadata.validate_arrays(arrays)
+    names, _ = flatten_params(template)
+    if tuple(names) != metadata.names:
+        raise ValueError(
+            "parameter name mismatch between template and metadata; "
+            f"first diff: {_first_diff(names, metadata.names)}"
+        )
+    return unflatten_params(template, arrays)
+
+
+def _first_diff(a: Iterable[str], b: Iterable[str]) -> str:
+    for x, y in zip(a, b):
+        if x != y:
+            return f"{x!r} vs {y!r}"
+    return "length mismatch"

@@ -1,0 +1,47 @@
+"""Config schema and model presets."""
+
+from __future__ import annotations
+
+import pathlib
+
+import yaml
+
+from photon_tpu.config.schema import (  # noqa: F401
+    AttnImpl,
+    CommStackConfig,
+    Config,
+    DatasetConfig,
+    FLConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    PhotonConfig,
+    SchedulerConfig,
+    StrategyName,
+    TrainConfig,
+)
+
+_PRESET_DIR = pathlib.Path(__file__).parent / "presets"
+
+
+def list_presets() -> list[str]:
+    return sorted(p.stem for p in _PRESET_DIR.glob("*.yaml"))
+
+
+def load_preset(name: str, **overrides) -> Config:
+    """Load a model preset (e.g. ``mpt-125m``) merged over defaults.
+
+    The preset YAML only sets model/optimizer/scheduler/train blocks; the
+    rest of :class:`Config` stays at defaults, then ``overrides`` dicts are
+    merged last (e.g. ``fl={"n_rounds": 10}``).
+    """
+    path = _PRESET_DIR / f"{name}.yaml"
+    if not path.exists():
+        raise ValueError(f"unknown preset {name!r}; available: {list_presets()}")
+    d = yaml.safe_load(path.read_text())
+    for key, val in overrides.items():
+        if isinstance(val, dict):
+            d.setdefault(key, {}).update(val)
+        else:
+            d[key] = val
+    return Config.from_dict(d).validate()

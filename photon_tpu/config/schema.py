@@ -1,0 +1,280 @@
+"""Typed configuration schema for photon-tpu.
+
+Mirrors the role of the reference's Hydra/pydantic schema
+(``photon/conf/base_schema.py:344-392``): one fully-resolved config object is
+the IPC of record — every process (server, node, executor, centralized
+trainer) loads the same resolved YAML dump.
+
+Plain dataclasses + explicit validation; YAML in/out via ``yaml.safe_load``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import pathlib
+import typing
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+
+class StrategyName(str, enum.Enum):
+    """Server-side aggregation strategies (reference: ``base_schema.py:100-137``)."""
+
+    FEDAVG = "fedavg"
+    NESTEROV = "nesterov"
+    FEDMOM = "fedmom"
+    FEDADAM = "fedadam"
+    FEDYOGI = "fedyogi"
+
+
+class AttnImpl(str, enum.Enum):
+    PALLAS = "pallas"  # blockwise flash attention kernel (TPU)
+    XLA = "xla"  # pure-XLA reference path (reference's ``attn_impl: torch``)
+
+
+@dataclass
+class ModelConfig:
+    """Decoder-only MPT-style model shape (reference: ``conf/llm_config/mpt-125m.yaml:18-28``)."""
+
+    name: str = "mpt-125m"
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    max_seq_len: int = 2048
+    vocab_size: int = 50368
+    expansion_ratio: int = 4
+    no_bias: bool = True
+    learned_pos_emb: bool = True
+    tie_embeddings: bool = True
+    attn_impl: str = AttnImpl.PALLAS.value
+    # Numerics: params kept fp32, compute in bf16 (reference: amp_bf16 + FSDP
+    # PURE mixed precision, ``mpt-125m.yaml:85-92``).
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+    logits_dtype: str = "float32"
+    emb_init_std: float = 0.02
+    resid_pdrop: float = 0.0
+    remat: bool = False  # activation checkpointing (reference: fsdp_config.activation_checkpointing)
+
+    @property
+    def d_head(self) -> int:
+        if self.d_model % self.n_heads:
+            raise ValueError(f"d_model {self.d_model} not divisible by n_heads {self.n_heads}")
+        return self.d_model // self.n_heads
+
+
+@dataclass
+class OptimizerConfig:
+    """Client-side optimizer (reference: ``mpt-125m.yaml:58-63`` uses ADOPT lr 6e-4)."""
+
+    name: str = "adopt"  # adopt | adamw
+    lr: float = 6.0e-4
+    betas: tuple[float, float] = (0.9, 0.9999)
+    eps: float = 1.0e-6
+    weight_decay: float = 0.0
+    grad_clip_norm: float = 1.0
+
+
+@dataclass
+class SchedulerConfig:
+    """Cosine-with-warmup (reference: ``mpt-125m.yaml`` scheduler block)."""
+
+    name: str = "cosine_with_warmup"
+    t_warmup: int = 100  # batches
+    t_max: int = 4800  # batches; total schedule horizon
+    alpha_f: float = 0.1  # final LR multiplier
+
+
+@dataclass
+class MeshConfig:
+    """Logical device mesh for one client slice.
+
+    Axes follow the TPU-idiomatic layout: ``data`` (batch DP), ``fsdp``
+    (weight sharding / ZeRO-3), ``tensor`` (TP), ``sequence`` (context
+    parallel / ring attention). The reference's DDP/FSDP/TP knobs
+    (``trainer_utils.py:1640-1720``) map onto mesh axis sizes here.
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    sequence: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.data * self.fsdp * self.tensor * self.sequence
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "tensor": self.tensor,
+            "sequence": self.sequence,
+        }
+
+
+@dataclass
+class TrainConfig:
+    """Per-client training loop config (reference: Composer Trainer knobs)."""
+
+    global_batch_size: int = 256
+    device_microbatch_size: int = 8  # grad-accumulation granularity
+    seed: int = 17
+    precision: str = "amp_bf16"
+    eval_interval: int = 0  # 0 = no mid-training eval
+    eval_batches: int = 8
+    log_interval: int = 10
+
+
+@dataclass
+class DatasetConfig:
+    """Sharded-dataset config (reference: streaming MDS, ``conf/dataset/*``)."""
+
+    local_path: str = ""
+    split_train: str = "train"
+    split_eval: str = "val"
+    shuffle: bool = True
+    shuffle_seed: int = 17
+    num_canonical_nodes: int = 1
+    synthetic: bool = False  # deterministic synthetic tokens (tests / no-data bench)
+
+
+@dataclass
+class CommStackConfig:
+    """Bulk-tensor transport selection (reference: ``base_schema.py:11-28``).
+
+    Exactly one of shm / objstore / collective should carry bulk tensors:
+    - shm: named POSIX shared memory, single-host (reference default).
+    - objstore: filesystem/S3-style object store, durable, cross-host.
+    - collective: jax.distributed DCN allreduce across client slices (the
+      marquee TPU-native path; no reference analog).
+    """
+
+    shm: bool = True
+    objstore: bool = False
+    collective: bool = False
+
+
+@dataclass
+class FLConfig:
+    """Federation hyperparameters (reference: ``base_schema.py`` fl block)."""
+
+    n_total_clients: int = 8
+    n_clients_per_round: int = 8
+    n_rounds: int = 320
+    local_steps: int = 128
+    strategy_name: str = StrategyName.NESTEROV.value
+    server_learning_rate: float = 1.0
+    server_momentum: float = 0.0
+    # adaptive server optimizers
+    server_beta_1: float = 0.9
+    server_beta_2: float = 0.99
+    server_tau: float = 1.0e-9
+    # lr scaling with sampled client count: none | linear | sqrt
+    client_count_scaling: str = "none"
+    aggregate_momenta: bool = False
+    accept_failures_cnt: int = 0
+    ignore_failed_rounds: bool = False
+    eval_interval_rounds: int = 0
+    sample_seed: int = 1234
+
+
+@dataclass
+class PhotonConfig:
+    """Node/process topology (reference: ``base_schema.py`` photon block)."""
+
+    n_nodes: int = 1
+    refresh_period: int = 0  # restart executors every N rounds; 0 = never
+    checkpoint: bool = True
+    checkpoint_interval: int = 1
+    keep_checkpoints: int = 3
+    resume_round: int | None = None  # negative = index from latest valid
+    restore_run_uuid: str | None = None
+    comm_stack: CommStackConfig = field(default_factory=CommStackConfig)
+    save_path: str = "/tmp/photon_tpu"
+
+
+@dataclass
+class Config:
+    """Root config (reference: ``BaseConfig``, ``base_schema.py:344-392``)."""
+
+    run_uuid: str = "dev"
+    seed: int = 17
+    photon: PhotonConfig = field(default_factory=PhotonConfig)
+    fl: FLConfig = field(default_factory=FLConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    dataset: DatasetConfig = field(default_factory=DatasetConfig)
+
+    # ------------------------------------------------------------------
+    # (de)serialization — the resolved config file is the IPC of record
+    # (reference: ``hydra_resolver.py:15-39``).
+    # ------------------------------------------------------------------
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_yaml(self, path: str | pathlib.Path) -> None:
+        p = pathlib.Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(yaml.safe_dump(self.to_dict(), sort_keys=False))
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Config":
+        return _build_dataclass(cls, d)
+
+    @classmethod
+    def from_yaml(cls, path: str | pathlib.Path) -> "Config":
+        return cls.from_dict(yaml.safe_load(pathlib.Path(path).read_text()) or {})
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        return cls.from_dict(json.loads(s))
+
+    def validate(self) -> "Config":
+        if self.fl.n_clients_per_round > self.fl.n_total_clients:
+            raise ValueError("n_clients_per_round > n_total_clients")
+        if self.train.global_batch_size % self.train.device_microbatch_size:
+            raise ValueError("global_batch_size must be divisible by device_microbatch_size")
+        StrategyName(self.fl.strategy_name)
+        AttnImpl(self.model.attn_impl)
+        if self.fl.client_count_scaling not in ("none", "linear", "sqrt"):
+            raise ValueError(f"bad client_count_scaling {self.fl.client_count_scaling}")
+        if self.model.resid_pdrop != 0.0:
+            raise ValueError("resid_pdrop > 0 is not implemented yet (dropout-free pretraining)")
+        _ = self.model.d_head
+        return self
+
+
+def _build_dataclass(cls: type, d: dict[str, Any]) -> Any:
+    """Recursively build a dataclass from a (possibly partial) dict.
+
+    Field types are resolved with ``typing.get_type_hints`` so nested
+    dataclasses work under PEP-563 string annotations without a registry.
+    """
+    if not dataclasses.is_dataclass(cls):
+        return d
+    kwargs: dict[str, Any] = {}
+    hints = typing.get_type_hints(cls)
+    field_names = {f.name for f in dataclasses.fields(cls)}
+    for name, value in (d or {}).items():
+        if name not in field_names:
+            raise ValueError(f"unknown config key {cls.__name__}.{name}")
+        ftype = hints.get(name)
+        if ftype is not None and dataclasses.is_dataclass(ftype) and isinstance(value, dict):
+            kwargs[name] = _build_dataclass(ftype, value)
+        elif name == "betas" and isinstance(value, (list, tuple)):
+            kwargs[name] = tuple(value)
+        else:
+            kwargs[name] = value
+    return cls(**kwargs)

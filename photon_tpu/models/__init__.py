@@ -1,0 +1,3 @@
+"""Model zoo: MPT-style decoder-only LMs (flax)."""
+
+from photon_tpu.models.mpt import MPTModel, init_params  # noqa: F401

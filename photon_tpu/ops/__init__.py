@@ -1,0 +1,3 @@
+"""TPU compute ops: attention dispatch, loss kernels."""
+
+from photon_tpu.ops.attention import multihead_attention  # noqa: F401

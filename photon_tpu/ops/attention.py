@@ -1,0 +1,67 @@
+"""Attention dispatch: Pallas flash kernel or pure-XLA fallback.
+
+The reference selects between CUDA flash-attention and a plain torch path via
+``attn_impl: flash|torch`` (``conf/llm_config/mpt-125m.yaml:27-28``,
+``README.md:96-100``). Here the same switch selects the blockwise Pallas TPU
+kernel (``attn_impl=pallas``) or a pure-XLA softmax attention
+(``attn_impl=xla``) that XLA fuses itself.
+
+All shapes are ``[batch, seq, heads, d_head]``; softmax runs in fp32
+regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+) -> jax.Array:
+    """Plain softmax attention; XLA fuses mask+softmax into the matmuls.
+
+    Numerically the oracle for the Pallas kernel's parity tests.
+    """
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    scale = 1.0 / (d**0.5)
+    # [b, h, s_q, s_k] in fp32 for a stable softmax
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if causal:
+        # offset supports s_q != s_k (e.g. decode); here typically equal
+        q_pos = jnp.arange(s_q)[:, None] + (s_k - s_q)
+        k_pos = jnp.arange(s_k)[None, :]
+        mask = q_pos >= k_pos
+        scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out
+
+
+@functools.partial(jax.named_call, name="multihead_attention")
+def multihead_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    impl: str = "pallas",
+    causal: bool = True,
+) -> jax.Array:
+    """Dispatch on ``impl`` ∈ {pallas, xla}. Falls back to XLA off-TPU."""
+    if impl == "pallas":
+        from photon_tpu.ops.flash_attention import flash_attention, pallas_supported
+
+        if pallas_supported(q):
+            return flash_attention(q, k, v, causal=causal)
+        impl = "xla"
+    if impl != "xla":
+        raise ValueError(f"unknown attention impl {impl!r}")
+    return xla_attention(q, k, v, causal=causal)

@@ -1,0 +1,2 @@
+from photon_tpu.optim.build import build_optimizer, build_schedule  # noqa: F401
+from photon_tpu.optim.adopt import adopt  # noqa: F401

@@ -1,0 +1,79 @@
+"""ADOPT optimizer (Taniguchi et al., 2024) as an optax transformation.
+
+The reference's 125M recipe trains with ADOPT lr 6e-4 via a fork of
+llm-foundry (``conf/llm_config/mpt-125m.yaml:58-63``). ADOPT decorrelates the
+second-moment estimate from the current gradient by normalizing with
+``v_{t-1}`` and updates in clipped normalized-gradient space:
+
+    step 0:  v_0 = g_0^2                       (no parameter update)
+    step t:  m_t = b1*m_{t-1} + (1-b1)*clip(g_t / max(sqrt(v_{t-1}), eps), c_t)
+             update = -lr * m_t
+             v_t = b2*v_{t-1} + (1-b2)*g_t^2
+    with clip bound c_t = t^{1/4}.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import chex
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class AdoptState(NamedTuple):
+    count: chex.Array  # int32 scalar, number of updates applied
+    m: optax.Updates
+    v: optax.Updates
+
+
+def adopt(
+    learning_rate: optax.ScalarOrSchedule,
+    b1: float = 0.9,
+    b2: float = 0.9999,
+    eps: float = 1.0e-6,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)  # noqa: E731
+        return AdoptState(
+            count=jnp.zeros([], jnp.int32),
+            m=jax.tree.map(zeros, params),
+            v=jax.tree.map(zeros, params),
+        )
+
+    def update_fn(updates, state, params=None):
+        count = state.count
+        is_first = count == 0
+        clip_bound = jnp.maximum(count.astype(jnp.float32), 1.0) ** 0.25
+
+        def next_m(g, m, v):
+            g = g.astype(jnp.float32)
+            normed = g / jnp.maximum(jnp.sqrt(v), eps)
+            normed = jnp.clip(normed, -clip_bound, clip_bound)
+            return jnp.where(is_first, m, b1 * m + (1.0 - b1) * normed)
+
+        def next_v(g, v):
+            g = g.astype(jnp.float32)
+            return jnp.where(is_first, g * g, b2 * v + (1.0 - b2) * g * g)
+
+        m_new = jax.tree.map(next_m, updates, state.m, state.v)
+        v_new = jax.tree.map(next_v, updates, state.v)
+
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        scale = jnp.where(is_first, 0.0, lr)
+
+        def delta(m, p):
+            d = -scale * m
+            if weight_decay and params is not None:
+                d = d - scale * weight_decay * p.astype(jnp.float32)
+            return d.astype(p.dtype) if p is not None else d
+
+        if params is not None:
+            new_updates = jax.tree.map(delta, m_new, params)
+        else:
+            new_updates = jax.tree.map(lambda m: -scale * m, m_new)
+        return new_updates, AdoptState(count=count + 1, m=m_new, v=v_new)
+
+    return optax.GradientTransformation(init_fn, update_fn)

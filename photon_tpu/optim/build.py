@@ -1,0 +1,59 @@
+"""Optimizer/schedule builders (reference: llm-foundry optimizer/scheduler
+builders used by ``trainer_utils.get_trainer_object``,
+``photon/clients/trainer_utils.py:107-121``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+from photon_tpu.config.schema import OptimizerConfig, SchedulerConfig
+from photon_tpu.optim.adopt import adopt
+
+
+def build_schedule(scfg: SchedulerConfig, base_lr: float) -> optax.Schedule:
+    """Cosine-with-warmup (reference scheduler: ``cosine_with_warmup``,
+    t_warmup 100ba, alpha_f 0.1 — ``conf/llm_config/mpt-125m.yaml``)."""
+    if scfg.name != "cosine_with_warmup":
+        raise ValueError(f"unknown scheduler {scfg.name!r}")
+    warmup = max(scfg.t_warmup, 0)
+    t_max = max(scfg.t_max, warmup + 1)
+
+    def schedule(count):
+        count = jnp.asarray(count, jnp.float32)
+        warm = count / jnp.maximum(warmup, 1)
+        frac = jnp.clip((count - warmup) / (t_max - warmup), 0.0, 1.0)
+        cos = scfg.alpha_f + (1.0 - scfg.alpha_f) * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        return base_lr * jnp.where(count < warmup, warm, cos)
+
+    return schedule
+
+
+def build_optimizer(
+    ocfg: OptimizerConfig, scfg: SchedulerConfig
+) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    """Returns (gradient transformation, lr schedule for logging)."""
+    schedule = build_schedule(scfg, ocfg.lr)
+    if ocfg.name == "adopt":
+        opt = adopt(
+            schedule,
+            b1=ocfg.betas[0],
+            b2=ocfg.betas[1],
+            eps=ocfg.eps,
+            weight_decay=ocfg.weight_decay,
+        )
+    elif ocfg.name == "adamw":
+        # decoupled AdamW (reference: ``decoupled_adamw``)
+        opt = optax.adamw(
+            schedule,
+            b1=ocfg.betas[0],
+            b2=ocfg.betas[1],
+            eps=ocfg.eps,
+            weight_decay=ocfg.weight_decay,
+        )
+    else:
+        raise ValueError(f"unknown optimizer {ocfg.name!r}")
+    chain = [opt]
+    if ocfg.grad_clip_norm and ocfg.grad_clip_norm > 0:
+        chain.insert(0, optax.clip_by_global_norm(ocfg.grad_clip_norm))
+    return optax.chain(*chain), schedule

@@ -1,0 +1,7 @@
+from photon_tpu.parallel.mesh import make_mesh  # noqa: F401
+from photon_tpu.parallel.sharding import (  # noqa: F401
+    batch_spec,
+    param_specs,
+    shard_params,
+    state_shardings,
+)

@@ -1,0 +1,37 @@
+"""Device mesh construction for one client slice.
+
+The reference expresses in-client parallelism as a gang of per-GPU worker
+processes wired by torch.distributed env vars (``worker/utils.py:94-159``)
+with DDP/FSDP/TP selected by Composer config (``trainer_utils.py:1640-1720``).
+TPU-native, all of that is one ``jax.sharding.Mesh`` with named axes; XLA
+emits the collectives over ICI.
+
+Axes (SURVEY.md §2.3 mapping):
+- ``data``     — batch data-parallel (DDP analog, grad allreduce)
+- ``fsdp``     — parameter/optimizer sharding (ZeRO-3 / FULL_SHARD analog)
+- ``tensor``   — tensor parallel (TP layer-plan analog)
+- ``sequence`` — context parallel (no reference analog; ring attention)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from photon_tpu.config.schema import MeshConfig
+
+AXES = ("data", "fsdp", "tensor", "sequence")
+
+
+def make_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if cfg.size > len(devices):
+        raise ValueError(f"mesh needs {cfg.size} devices, have {len(devices)}")
+    devs = np.asarray(devices[: cfg.size]).reshape(cfg.data, cfg.fsdp, cfg.tensor, cfg.sequence)
+    return Mesh(devs, AXES)
+
+
+def single_device_mesh(device=None) -> Mesh:
+    device = device or jax.devices()[0]
+    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1), AXES)

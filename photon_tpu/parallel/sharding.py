@@ -1,0 +1,108 @@
+"""GSPMD sharding rules for MPT parameters, batches and optimizer state.
+
+The reference's parallelism plumbing — FSDP FULL_SHARD config
+(``mpt-125m.yaml:85-92``), TP layer plan (``trainer_utils.py:1640-1648``) —
+becomes a table of ``PartitionSpec`` rules here. XLA inserts the
+all-gather/reduce-scatter collectives over ICI; nothing else to wire.
+
+Layout logic (params carry a leading ``[n_layers]`` scan axis):
+- ``wqkv``/``up_proj`` kernels  [L, D, F]: column-parallel — F on ``tensor``,
+  D on ``fsdp``.
+- ``out_proj``/``down_proj``    [L, F, D]: row-parallel — F on ``tensor``,
+  D on ``fsdp``.
+- ``wte`` [V, D]: V on ``fsdp``, D on ``tensor``. ``wpe`` [S, D]: D on fsdp.
+- LayerNorm scales: replicated (tiny).
+- Batches [B, S]: B over (``data``, ``fsdp``) — fsdp is data-parallel with
+  sharded state, exactly ZeRO-3 — and S over ``sequence``.
+
+Any dimension not divisible by its mesh axis is replicated instead (with the
+axis silently dropped), keeping small/odd shapes valid on any mesh.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# ordered (path-regex, spec) rules; first match wins. Specs are written for
+# the [L, in, out] stacked-block layout; non-block params are 1-2D.
+_RULES: list[tuple[str, P]] = [
+    (r"wte/embedding$", P("fsdp", "tensor")),
+    (r"^wpe$", P(None, "fsdp")),
+    (r"(wqkv|up_proj)/kernel$", P(None, "fsdp", "tensor")),
+    (r"(out_proj|down_proj)/kernel$", P(None, "tensor", "fsdp")),
+    (r"(wqkv|up_proj)/bias$", P(None, "tensor")),
+    (r"(out_proj|down_proj)/bias$", P(None, "fsdp")),
+    (r"lm_head/kernel$", P("tensor", "fsdp")),
+    (r"(ln_1|ln_2|ln_f)/(scale|bias)$", P()),
+]
+
+
+def _fit_spec(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
+    """Drop axes that don't divide the dimension (or overflow rank)."""
+    out = []
+    for i, dim in enumerate(shape):
+        axis = spec[i] if i < len(spec) else None
+        if axis is None:
+            out.append(None)
+            continue
+        axis_size = int(np.prod([mesh.shape[a] for a in (axis if isinstance(axis, tuple) else (axis,))]))
+        out.append(axis if dim % axis_size == 0 else None)
+    return P(*out)
+
+
+def param_specs(params: Any, mesh: Mesh) -> Any:
+    """Pytree of PartitionSpec matching ``params`` structure."""
+
+    def spec_for(path, leaf) -> P:
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        for pattern, spec in _RULES:
+            if re.search(pattern, name):
+                return _fit_spec(spec, np.shape(leaf), mesh)
+        return P()  # replicate unknowns
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Place a host-resident param pytree onto the mesh per the rules."""
+    specs = param_specs(params, mesh)
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)), params, specs
+    )
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """Tokens [B, S]: batch over data+fsdp, sequence over sequence axis."""
+    del mesh
+    return P(("data", "fsdp"), "sequence")
+
+
+def state_shardings(state: Any, mesh: Mesh) -> Any:
+    """NamedSharding pytree for a :class:`~photon_tpu.train.TrainState`.
+
+    Params follow the rule table; optimizer moments inherit their parameter's
+    spec by shape lookup (ZeRO-3 semantics — optimizer state lives with the
+    weight shard, reference: FSDP FULL_SHARD sharded state dicts,
+    ``photon/utils.py:279-309``); scalars/counters are replicated.
+
+    ``state`` may hold real arrays or ``jax.ShapeDtypeStruct`` (from
+    ``jax.eval_shape``), so this also produces out_shardings for jit.
+    """
+    pspecs = param_specs(state.params, mesh)
+    shape_to_spec: dict[tuple, P] = {}
+    for leaf, spec in zip(jax.tree.leaves(state.params), jax.tree.leaves(pspecs)):
+        shape_to_spec.setdefault(tuple(np.shape(leaf)), spec)
+
+    def spec_of(leaf) -> P:
+        return shape_to_spec.get(tuple(np.shape(leaf)), P())
+
+    opt_specs = jax.tree.map(spec_of, state.opt_state)
+    specs = state.replace(step=P(), params=pspecs, opt_state=opt_specs)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )

@@ -1,0 +1,11 @@
+from photon_tpu.shm.plane import (  # noqa: F401
+    ShmSegment,
+    read_blob,
+    read_params,
+    read_scalar,
+    unlink,
+    wait_for,
+    write_blob,
+    write_params,
+    write_scalar,
+)

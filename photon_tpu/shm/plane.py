@@ -1,0 +1,286 @@
+"""Named shared-memory plane: zero-copy parameter hand-off on one host.
+
+Role parity with the reference's shm codec (``photon/shm/utils.py``): model
+weights travel between processes as one flat buffer + metadata, never through
+the control-plane message payload (SURVEY.md "big architectural idea").
+
+Design differences (deliberate, TPU-host-native):
+- Segments are plain files in ``/dev/shm`` accessed via ``mmap`` — tmpfs
+  pages, same zero-copy properties as POSIX ``shm_open``, but *no*
+  ``multiprocessing.resource_tracker`` involvement, which removes the entire
+  class of premature-unlink bugs the reference monkeypatches around
+  (bpo-38119 workaround, ``shm/utils.py:403-429``).
+- The segment is self-describing: a fixed header (magic, payload length,
+  metadata length, commit flag) precedes the metadata JSON and the raw
+  array bytes, so readers need only the name. The commit flag is written
+  last (after an ``mmap.flush``-visible full payload), making the
+  write-then-spin-wait protocol race-free without locks (single-writer /
+  multi-reader, reference protocol: ``worker.py:241-252`` spin-wait).
+- Large copies fan out over a thread pool (numpy releases the GIL on
+  memcpy), the analog of the reference's threaded ``set_parameters_shm``
+  (``shm/utils.py:626-651``).
+
+Layout: ``[16B header][metadata JSON][payload bytes]``.
+Header: magic ``u32``, version ``u32``, meta_len ``u32``, committed ``u32``.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import pathlib
+import pickle
+import struct
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any
+
+import numpy as np
+
+from photon_tpu.codec import ParamsMetadata
+
+SHM_DIR = pathlib.Path(os.environ.get("PHOTON_SHM_DIR", "/dev/shm"))
+_MAGIC = 0x50484F54  # "PHOT"
+_VERSION = 1
+_HEADER = struct.Struct("<IIII")
+_COPY_CHUNK = 64 << 20  # 64 MiB per copy task
+_POOL = ThreadPoolExecutor(max_workers=min(8, os.cpu_count() or 1))
+
+# name suffixes (reference: ``shm/constants.py:5-12`` `{uuid}+suffix` scheme)
+PARAMS_SUFFIX = "-params"
+CONFIG_SUFFIX = "-config"
+METRICS_SUFFIX = "-metrics"
+RESULT_SUFFIX = "-result"
+
+
+def _path(name: str) -> pathlib.Path:
+    if "/" in name or name.startswith("."):
+        raise ValueError(f"bad shm name {name!r}")
+    return SHM_DIR / f"photon-{name}"
+
+
+class ShmSegment:
+    """A mapped segment; use the module-level helpers for one-shot IO."""
+
+    def __init__(
+        self,
+        name: str,
+        size: int | None = None,
+        create: bool = False,
+        path: pathlib.Path | None = None,
+    ):
+        self.name = name
+        p = path if path is not None else _path(name)
+        if create:
+            if size is None:
+                raise ValueError("size required to create")
+            fd = os.open(p, os.O_CREAT | os.O_RDWR, 0o600)
+            try:
+                os.ftruncate(fd, _HEADER.size + size)
+                self.mm = mmap.mmap(fd, _HEADER.size + size)
+            finally:
+                os.close(fd)
+            self.mm[: _HEADER.size] = _HEADER.pack(_MAGIC, _VERSION, 0, 0)
+        else:
+            fd = os.open(p, os.O_RDWR)
+            try:
+                total = os.fstat(fd).st_size
+                self.mm = mmap.mmap(fd, total)
+            finally:
+                os.close(fd)
+            magic, version, _, _ = _HEADER.unpack_from(self.mm, 0)
+            if magic != _MAGIC or version != _VERSION:
+                raise ValueError(f"segment {name!r} has bad header")
+
+    # -- header ---------------------------------------------------------
+    @property
+    def committed(self) -> bool:
+        return _HEADER.unpack_from(self.mm, 0)[3] == 1
+
+    def commit(self, meta_len: int) -> None:
+        self.mm[: _HEADER.size] = _HEADER.pack(_MAGIC, _VERSION, meta_len, 1)
+
+    @property
+    def meta_len(self) -> int:
+        return _HEADER.unpack_from(self.mm, 0)[2]
+
+    def payload(self) -> memoryview:
+        return memoryview(self.mm)[_HEADER.size + self.meta_len :]
+
+    def body(self) -> memoryview:
+        return memoryview(self.mm)[_HEADER.size :]
+
+    def close(self) -> None:
+        self.mm.close()
+
+
+def _parallel_copy(dst: memoryview, src: memoryview) -> None:
+    n = len(src)
+    if n <= _COPY_CHUNK:
+        dst[:n] = src
+        return
+    d = np.frombuffer(dst, np.uint8, count=n)
+    s = np.frombuffer(src, np.uint8, count=n)
+    futures = [
+        _POOL.submit(np.copyto, d[off : off + _COPY_CHUNK], s[off : off + _COPY_CHUNK])
+        for off in range(0, n, _COPY_CHUNK)
+    ]
+    for f in futures:
+        f.result()
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+
+def write_params(name: str, metadata: ParamsMetadata, arrays: list[np.ndarray]) -> None:
+    """Serialize the flat array list into the named segment and commit."""
+    metadata.validate_arrays(arrays)
+    meta_bytes = metadata.to_json().encode()
+    # write into a private temp file, then atomically rename over the final
+    # name: readers (wait_for / read_params) only ever map a fully-committed
+    # segment — no window where a stale committed=1 header fronts new bytes
+    final = _path(name)
+    tmp = final.parent / (final.name + f".tmp-{os.getpid()}")
+    seg = ShmSegment(name, size=len(meta_bytes) + metadata.total_bytes, create=True, path=tmp)
+    try:
+        body = seg.body()
+        try:
+            body[: len(meta_bytes)] = meta_bytes
+            off = len(meta_bytes)
+            for a in arrays:
+                a = np.ascontiguousarray(a)
+                raw = a.reshape(-1).view(np.uint8)
+                chunk = body[off : off + a.nbytes]
+                try:
+                    _parallel_copy(chunk, memoryview(raw))
+                finally:
+                    chunk.release()
+                off += a.nbytes
+        finally:
+            body.release()
+        seg.commit(len(meta_bytes))
+    except BaseException:
+        seg.close()
+        tmp.unlink(missing_ok=True)
+        raise
+    seg.close()
+    os.rename(tmp, final)
+
+
+def read_params(name: str, copy: bool = False) -> tuple[ParamsMetadata, list[np.ndarray]]:
+    """Map the segment and return (metadata, arrays).
+
+    ``copy=False`` returns zero-copy views valid until the segment is
+    unlinked; the reference deep-copies before unlink for the same
+    use-after-free reason (``node_manager_app.py:560-567``)."""
+    seg = ShmSegment(name)
+    if not seg.committed:
+        seg.close()
+        raise BlockingIOError(f"segment {name!r} not committed yet")
+    meta = ParamsMetadata.from_json(bytes(seg.body()[: seg.meta_len]).decode())
+    payload = seg.payload()
+    arrays: list[np.ndarray] = []
+    off = 0
+    for shape, dtype, nbytes in zip(meta.shapes, meta.dtypes, meta.nbytes_each):
+        view = np.frombuffer(
+            payload, dtype=np.dtype(dtype), count=int(np.prod(shape, dtype=np.int64)), offset=off
+        ).reshape(shape)
+        arrays.append(view.copy() if copy else view)
+        del view
+        off += nbytes
+    if copy:
+        # all refs to the buffer dropped → the map can close now; zero-copy
+        # readers instead keep the mapping alive through the views
+        payload.release()
+        seg.close()
+    return meta, arrays
+
+
+# ---------------------------------------------------------------------------
+# pickled blobs (configs, metric dicts) + scalars
+# ---------------------------------------------------------------------------
+
+
+def write_blob(name: str, obj: Any) -> None:
+    """Pickled object cell (reference: ``set_dict_configsrecord_shm``,
+    ``shm/utils.py:432-522``)."""
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    final = _path(name)
+    tmp = final.parent / (final.name + f".tmp-{os.getpid()}")
+    seg = ShmSegment(name, size=len(data), create=True, path=tmp)
+    try:
+        seg.body()[: len(data)] = data
+        seg.commit(0)
+    except BaseException:
+        seg.close()
+        tmp.unlink(missing_ok=True)
+        raise
+    seg.close()
+    os.rename(tmp, final)
+
+
+def read_blob(name: str) -> Any:
+    seg = ShmSegment(name)
+    try:
+        if not seg.committed:
+            raise BlockingIOError(f"segment {name!r} not committed yet")
+        return pickle.loads(bytes(seg.payload()))
+    finally:
+        seg.close()
+
+
+def write_scalar(name: str, value: float) -> None:
+    """Scalar cell (reference: n_samples/eval_loss cells, ``shm/utils.py:271-369``)."""
+    write_blob(name, float(value))
+
+
+def read_scalar(name: str) -> float:
+    return float(read_blob(name))
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+
+def wait_for(name: str, timeout: float = 60.0, poll: float = 0.01) -> None:
+    """Block until the segment exists and is committed (reference spin-wait:
+    ``worker.py:241-252``)."""
+    deadline = time.monotonic() + timeout
+    path = _path(name)
+    while time.monotonic() < deadline:
+        if path.exists():
+            try:
+                seg = ShmSegment(name)
+                ok = seg.committed
+                seg.close()
+                if ok:
+                    return
+            except (ValueError, OSError):
+                pass
+        time.sleep(poll)
+    raise TimeoutError(f"shm segment {name!r} not ready after {timeout}s")
+
+
+def unlink(name: str, missing_ok: bool = True) -> None:
+    try:
+        _path(name).unlink()
+    except FileNotFoundError:
+        if not missing_ok:
+            raise
+
+
+def cleanup_stale(prefix: str = "") -> int:
+    """Remove leftover segments (reference: ``clean_stale_shared_memory`` /
+    streaming-shm leak cleanup, ``clients/utils.py:655-673``)."""
+    n = 0
+    for p in SHM_DIR.glob(f"photon-{prefix}*"):
+        try:
+            p.unlink()
+            n += 1
+        except OSError:
+            pass
+    return n

@@ -1,0 +1,14 @@
+from photon_tpu.strategy.aggregation import (  # noqa: F401
+    aggregate_inplace,
+    weighted_average_metrics,
+    weighted_loss_avg,
+)
+from photon_tpu.strategy.base import ClientResult, Strategy  # noqa: F401
+from photon_tpu.strategy.dispatcher import dispatch_strategy  # noqa: F401
+from photon_tpu.strategy.optimizers import (  # noqa: F401
+    FedAdam,
+    FedAvgEff,
+    FedMom,
+    FedNesterov,
+    FedYogi,
+)

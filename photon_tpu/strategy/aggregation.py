@@ -1,0 +1,75 @@
+"""Streaming in-place weighted aggregation.
+
+Reference semantics (``photon/strategy/aggregation.py:44-118``): consume
+client results one at a time from a generator — only one client's tensors are
+materialized beyond the running average at any moment — maintaining
+
+    x_i = x_i * (n_prev / n_new) + y_i * (n_cur / n_new)
+
+per layer, where ``n_prev`` is the sample count already folded in, ``n_cur``
+the incoming client's count, ``n_new = n_prev + n_cur``. Mathematically equal
+to the sample-weighted mean but O(1) in memory w.r.t. client count.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+import numpy as np
+
+
+def aggregate_inplace(
+    results: Iterable[tuple[list[np.ndarray], int]],
+) -> tuple[list[np.ndarray], int]:
+    """Streaming sample-weighted mean over ``(arrays, n_samples)`` results.
+
+    Returns (averaged arrays, total samples). The first result's arrays are
+    copied (fp64 accumulate is deliberate — matches the reference's float
+    numpy accumulation and keeps the running rescale stable)."""
+    it: Iterator = iter(results)
+    try:
+        first_arrays, n_total = next(it)
+    except StopIteration:
+        raise ValueError("aggregate_inplace: empty results") from None
+    if n_total <= 0:
+        raise ValueError(f"non-positive n_samples {n_total}")
+    acc = [np.asarray(a, dtype=np.float64) for a in first_arrays]
+    for arrays, n_cur in it:
+        if n_cur <= 0:
+            raise ValueError(f"non-positive n_samples {n_cur}")
+        n_new = n_total + n_cur
+        w_prev = n_total / n_new
+        w_cur = n_cur / n_new
+        for i, y in enumerate(arrays):
+            acc[i] *= w_prev
+            acc[i] += np.asarray(y, dtype=np.float64) * w_cur
+        n_total = n_new
+    return [a.astype(np.float32) for a in acc], n_total
+
+
+def weighted_loss_avg(results: Iterable[tuple[int, float]]) -> float:
+    """Sample-weighted mean loss (reference: flwr's ``weighted_loss_avg`` used
+    by ``evaluate_utils.py:33-158``)."""
+    results = list(results)
+    total = sum(n for n, _ in results)
+    if total == 0:
+        raise ValueError("weighted_loss_avg: zero total samples")
+    return float(sum(n * loss for n, loss in results) / total)
+
+
+def weighted_average_metrics(
+    results: Iterable[tuple[int, dict[str, float]]],
+) -> dict[str, float]:
+    """Sample-weighted mean of per-client scalar metric dicts (reference:
+    ``strategy/aggregation.py:172`` ``weighted_average``)."""
+    results = [(n, m) for n, m in results]
+    total = sum(n for n, _ in results)
+    if total == 0:
+        return {}
+    keys: set[str] = set()
+    for _, m in results:
+        keys.update(m)
+    return {
+        k: float(sum(n * m[k] for n, m in results if k in m) / sum(n for n, m in results if k in m))
+        for k in keys
+    }

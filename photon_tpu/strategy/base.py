@@ -1,0 +1,162 @@
+"""Strategy base: pseudo-gradient server optimization over flat ndarray lists.
+
+Reference architecture (``photon/strategy/fedavg_eff.py`` etc.): the server
+holds the global parameters; each round it averages client parameters
+(streaming, sample-weighted), forms the pseudo-gradient
+
+    g_i = x_i - avg_i        (per layer)
+
+and applies a server-side optimizer update layer by layer. Subclasses
+implement :meth:`server_update`. ``state_keys`` declare which optimizer state
+tensors are checkpointed alongside the parameters (reference:
+``fedadam.py:197-201``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Iterable
+
+import numpy as np
+
+from photon_tpu.strategy.aggregation import aggregate_inplace, weighted_average_metrics
+
+
+@dataclasses.dataclass
+class ClientResult:
+    """One client's round output (the FitRes analog)."""
+
+    cid: int
+    arrays: list[np.ndarray]
+    n_samples: int
+    metrics: dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+def l2_norm(arrays: Iterable[np.ndarray]) -> float:
+    return math.sqrt(sum(float(np.sum(np.square(a, dtype=np.float64))) for a in arrays))
+
+
+class Strategy:
+    """Base server strategy.
+
+    ``current_parameters`` (and any momenta) are injected after init/resume
+    (reference: ``initialize_strategy``, ``photon/strategy/utils.py:13-54``).
+    """
+
+    name = "base"
+    #: names of per-layer state lists checkpointed with the params
+    state_keys: tuple[str, ...] = ()
+
+    def __init__(
+        self,
+        server_learning_rate: float = 1.0,
+        server_momentum: float = 0.0,
+        client_count_scaling: str = "none",
+        telemetry: bool = True,
+        **_: Any,
+    ) -> None:
+        self.eta = server_learning_rate
+        self.momentum = server_momentum
+        self.client_count_scaling = client_count_scaling
+        self.telemetry = telemetry
+        self.current_parameters: list[np.ndarray] | None = None
+        self.state: dict[str, list[np.ndarray]] = {}
+        self.server_round = 0
+
+    # ------------------------------------------------------------------
+    def initialize(self, parameters: list[np.ndarray], state: dict[str, list[np.ndarray]] | None = None) -> None:
+        self.current_parameters = [np.asarray(p, np.float32) for p in parameters]
+        if state:
+            self.state = {k: [np.asarray(a, np.float32) for a in v] for k, v in state.items()}
+        for key in self.state_keys:
+            if key not in self.state:
+                self.state[key] = [np.zeros_like(p) for p in self.current_parameters]
+
+    def effective_lr(self, n_clients: int) -> float:
+        """lr scaling with sampled-client count (reference:
+        ``fedavg_eff.py:291-330`` linear/sqrt options)."""
+        if self.client_count_scaling == "linear":
+            return self.eta * n_clients
+        if self.client_count_scaling == "sqrt":
+            return self.eta * math.sqrt(n_clients)
+        return self.eta
+
+    # ------------------------------------------------------------------
+    def aggregate_fit(
+        self, server_round: int, results: Iterable[ClientResult]
+    ) -> tuple[list[np.ndarray], dict[str, float]]:
+        """Streaming average → pseudo-gradient → server optimizer.
+
+        ``results`` may be a generator; client tensors are folded into the
+        running average one at a time (reference: ``handle_fit_replies`` lazy
+        pipeline, ``server/fit_utils.py:92-217``).
+        """
+        if self.current_parameters is None:
+            raise RuntimeError("strategy not initialized with parameters")
+        self.server_round = server_round
+
+        seen: list[tuple[int, dict[str, float]]] = []
+
+        def stream():
+            for r in results:
+                seen.append((r.n_samples, r.metrics))
+                yield r.arrays, r.n_samples
+
+        avg, n_total = aggregate_inplace(stream())
+        n_clients = len(seen)
+
+        # pseudo-gradient per layer
+        pseudo_grad = [x - a for x, a in zip(self.current_parameters, avg)]
+        lr = self.effective_lr(n_clients)
+        new_params = self.server_update(pseudo_grad, lr)
+
+        metrics: dict[str, float] = {
+            "server/n_clients": float(n_clients),
+            "server/n_samples": float(n_total),
+            "server/effective_lr": lr,
+        }
+        if self.telemetry:
+            metrics.update(self.norm_telemetry(pseudo_grad))
+        metrics.update(weighted_average_metrics(seen))
+        self.current_parameters = new_params
+        return new_params, metrics
+
+    def aggregate_evaluate(
+        self, server_round: int, results: Iterable[tuple[int, float, dict[str, float]]]
+    ) -> tuple[float, dict[str, float]]:
+        """Sample-weighted eval-loss aggregation (reference:
+        ``evaluate_utils.py:33-158``)."""
+        results = list(results)
+        from photon_tpu.strategy.aggregation import weighted_loss_avg
+
+        loss = weighted_loss_avg([(n, l) for n, l, _ in results])
+        metrics = weighted_average_metrics([(n, m) for n, l, m in results])
+        metrics["server/eval_loss"] = loss
+        return loss, metrics
+
+    # ------------------------------------------------------------------
+    def server_update(self, pseudo_grad: list[np.ndarray], lr: float) -> list[np.ndarray]:
+        raise NotImplementedError
+
+    def norm_telemetry(self, pseudo_grad: list[np.ndarray]) -> dict[str, float]:
+        """Global L2 norms of pseudo-grad / params / momenta (reference
+        per-layer + global norms, ``fedadam.py:333-381``; per-layer norms are
+        computed on demand by callers to keep round metrics compact)."""
+        out = {
+            "server/pseudo_grad_norm": l2_norm(pseudo_grad),
+            "server/param_norm": l2_norm(self.current_parameters or []),
+        }
+        for key, tensors in self.state.items():
+            out[f"server/{key}_norm"] = l2_norm(tensors)
+        return out
+
+    def per_layer_norms(self, names: list[str], arrays: list[np.ndarray], prefix: str) -> dict[str, float]:
+        return {
+            f"{prefix}/{n}": float(np.linalg.norm(a.astype(np.float64)))
+            for n, a in zip(names, arrays)
+        }
+
+    # checkpointing --------------------------------------------------------
+    def state_for_checkpoint(self) -> dict[str, list[np.ndarray]]:
+        return {k: self.state[k] for k in self.state_keys if k in self.state}

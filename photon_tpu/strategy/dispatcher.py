@@ -1,0 +1,27 @@
+"""Strategy dispatch by config name (reference: ``strategy/dispatcher.py:44-165``)."""
+
+from __future__ import annotations
+
+from photon_tpu.config.schema import FLConfig, StrategyName
+from photon_tpu.strategy.base import Strategy
+from photon_tpu.strategy.optimizers import FedAdam, FedAvgEff, FedMom, FedNesterov, FedYogi
+
+_REGISTRY: dict[StrategyName, type[Strategy]] = {
+    StrategyName.FEDAVG: FedAvgEff,
+    StrategyName.NESTEROV: FedNesterov,
+    StrategyName.FEDMOM: FedMom,
+    StrategyName.FEDADAM: FedAdam,
+    StrategyName.FEDYOGI: FedYogi,
+}
+
+
+def dispatch_strategy(fl: FLConfig) -> Strategy:
+    cls = _REGISTRY[StrategyName(fl.strategy_name)]
+    return cls(
+        server_learning_rate=fl.server_learning_rate,
+        server_momentum=fl.server_momentum,
+        server_beta_1=fl.server_beta_1,
+        server_beta_2=fl.server_beta_2,
+        server_tau=fl.server_tau,
+        client_count_scaling=fl.client_count_scaling,
+    )

@@ -1,0 +1,7 @@
+from photon_tpu.train.train_step import (  # noqa: F401
+    TrainState,
+    init_train_state,
+    make_eval_step,
+    make_loss_fn,
+    make_train_step,
+)

@@ -1,0 +1,111 @@
+"""The jitted training step — photon-tpu's replacement for the Composer
+Trainer's inner loop (reference: ``trainer.fit`` hot loop,
+``photon/clients/llm_client_functions.py:206`` → Composer → torch/NCCL).
+
+One function, traced once: microbatch scan (grad accumulation) → grad mean →
+clip → optimizer → param update. Under ``jit`` over a Mesh, XLA inserts all
+DP/FSDP/TP collectives on ICI (SURVEY.md §2.3-2.4). Causal-LM cross-entropy
+with next-token shift; loss in fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from photon_tpu.models.mpt import MPTModel
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Carried across steps and across federated rounds (the analog of the
+    persistent Composer Trainer state, ``worker/worker.py:207,254``)."""
+
+    step: jax.Array  # int32 — local step counter (timestamp.batch analog)
+    params: Any
+    opt_state: Any
+
+
+def make_loss_fn(model: MPTModel) -> Callable:
+    def loss_fn(params, tokens: jax.Array):
+        """Mean next-token cross entropy over ``[B, S] int32`` tokens."""
+        logits = model.apply({"params": params}, tokens)
+        targets = tokens[:, 1:]
+        logits = logits[:, :-1]
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), targets
+        )
+        return jnp.mean(ce)
+
+    return loss_fn
+
+
+def make_train_step(
+    model: MPTModel,
+    tx: optax.GradientTransformation,
+    n_microbatches: int = 1,
+) -> Callable:
+    """Build the pure train-step fn ``(state, tokens) -> (state, metrics)``.
+
+    ``tokens`` is ``[global_batch, seq]``; with ``n_microbatches > 1`` the
+    batch is scanned in chunks and gradients averaged — the deterministic
+    analog of the reference's ``device_train_microbatch_size`` grad
+    accumulation (``conf/llm_config/mpt-125m.yaml:80-81``).
+    """
+    loss_fn = make_loss_fn(model)
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def train_step(state: TrainState, tokens: jax.Array):
+        if n_microbatches > 1:
+            b = tokens.shape[0]
+            if b % n_microbatches:
+                raise ValueError(f"batch {b} not divisible by {n_microbatches} microbatches")
+            micro = tokens.reshape(n_microbatches, b // n_microbatches, tokens.shape[1])
+
+            def body(carry, mb):
+                loss_acc, grad_acc = carry
+                loss, grads = grad_fn(state.params, mb)
+                return (loss_acc + loss, jax.tree.map(jnp.add, grad_acc, grads)), None
+
+            zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (loss_sum, grad_sum), _ = jax.lax.scan(body, (jnp.zeros([], jnp.float32), zero_grads), micro)
+            loss = loss_sum / n_microbatches
+            grads = jax.tree.map(lambda g: g / n_microbatches, grad_sum)
+        else:
+            loss, grads = grad_fn(state.params, tokens)
+
+        grad_norm = optax.global_norm(grads)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt_state)
+        metrics = {
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "param_norm": optax.global_norm(new_params),
+        }
+        return new_state, metrics
+
+    return train_step
+
+
+def make_eval_step(model: MPTModel) -> Callable:
+    """``(params, tokens) -> (sum_ce, n_tokens)`` for loss aggregation across
+    eval batches (reference: ``llm_eval`` collecting ``eval_metric_values``,
+    ``clients/llm_client_functions.py:231-353``)."""
+    def eval_step(params, tokens: jax.Array):
+        logits = model.apply({"params": params}, tokens)
+        targets = tokens[:, 1:]
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1].astype(jnp.float32), targets
+        )
+        return jnp.sum(ce), jnp.asarray(ce.size, jnp.int32)
+
+    return eval_step
+
+
+def init_train_state(model: MPTModel, tx: optax.GradientTransformation, params: Any) -> TrainState:
+    return TrainState(step=jnp.zeros([], jnp.int32), params=params, opt_state=tx.init(params))

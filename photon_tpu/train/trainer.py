@@ -1,0 +1,212 @@
+"""Trainer: the persistent per-client training runtime.
+
+Replaces the reference's Composer ``Trainer`` assembly + reuse machinery
+(``photon/clients/trainer_utils.py:1117-1721``, ``TrainerMutableAttributes``
+``:172-202``): one object owning the jitted sharded train step, the sharded
+:class:`TrainState`, and the host loop. Persistent across federated rounds —
+optimizer state and the step counter survive, matching the reference's
+``external_trainer`` reuse semantics (``worker/worker.py:207,254``).
+
+TPU-first: a "client" is a mesh slice driven by ONE pjit'd step; DP/FSDP/TP
+collectives are XLA-inserted over ICI. Parameter exchange with the federation
+layer goes through the flat-ndarray codec (host side), mirroring the
+reference's FSDP gather/scatter at round boundaries (``utils.py:247-319``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+from photon_tpu.codec import ParamsMetadata, params_from_ndarrays, params_to_ndarrays
+from photon_tpu.config.schema import Config
+from photon_tpu.models.mpt import MPTModel, init_params
+from photon_tpu.optim import build_optimizer
+from photon_tpu.parallel.mesh import make_mesh
+from photon_tpu.parallel.sharding import batch_spec, state_shardings
+from photon_tpu.train.train_step import (
+    TrainState,
+    init_train_state,
+    make_eval_step,
+    make_train_step,
+)
+
+
+def _set_opt_count(opt_state: Any, step: int) -> Any:
+    """Return ``opt_state`` with every ``count`` field (optax's step counter
+    in AdoptState / ScaleByAdamState / ...) set to ``step``."""
+
+    def visit(path, leaf):
+        last = path[-1] if path else None
+        name = getattr(last, "name", getattr(last, "key", None))
+        if name == "count":
+            return jnp.asarray(step, leaf.dtype if hasattr(leaf, "dtype") else jnp.int32)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(visit, opt_state)
+
+
+class Trainer:
+    def __init__(
+        self,
+        cfg: Config,
+        mesh=None,
+        params: Any | None = None,
+        init_seed: int | None = None,
+    ) -> None:
+        self.cfg = cfg
+        self.model = MPTModel(cfg.model)
+        self.tx, self.lr_schedule = build_optimizer(cfg.optimizer, cfg.scheduler)
+        self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
+
+        # device_microbatch_size is PER DEVICE (reference:
+        # ``device_train_microbatch_size``); a scan step processes
+        # micro × dp_degree global rows, where dp_degree covers the batch-
+        # sharded mesh axes (data and fsdp)
+        dp_degree = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        rows_per_scan = cfg.train.device_microbatch_size * dp_degree
+        n_micro = max(1, cfg.train.global_batch_size // rows_per_scan)
+        step_fn = make_train_step(self.model, self.tx, n_microbatches=n_micro)
+        self._n_micro = n_micro
+        self._last_set_time = 0.0
+
+        if params is None:
+            params = init_params(cfg.model, seed=cfg.seed if init_seed is None else init_seed)
+        host_state = init_train_state(self.model, self.tx, params)
+        self._shardings = state_shardings(host_state, self.mesh)
+        self.state: TrainState = jax.tree.map(
+            lambda leaf, sh: jax.device_put(leaf, sh), host_state, self._shardings
+        )
+        self._batch_sharding = NamedSharding(self.mesh, batch_spec(self.mesh))
+        self._train_step = jax.jit(
+            step_fn,
+            in_shardings=(self._shardings, self._batch_sharding),
+            out_shardings=(self._shardings, None),
+            donate_argnums=0,
+        )
+        self._eval_step = jax.jit(
+            make_eval_step(self.model),
+            in_shardings=(self._shardings.params, self._batch_sharding),
+        )
+
+    # ------------------------------------------------------------------
+    # training / eval loops
+    # ------------------------------------------------------------------
+
+    def fit(
+        self,
+        batches: Iterable[np.ndarray],
+        duration_steps: int,
+        log_every: int = 0,
+        callback: Callable[[int, dict[str, float]], None] | None = None,
+    ) -> dict[str, float]:
+        """Run ``duration_steps`` steps (reference:
+        ``trainer.fit(duration=local_steps)``, ``llm_client_functions.py:206``).
+
+        Returns summary metrics including the reference's KPI names
+        (``client/fit_time``, BASELINE.md KPI table).
+        """
+        it: Iterator[np.ndarray] = iter(batches)
+        t0 = time.monotonic()
+        losses: list[float] = []
+        last_metrics: dict[str, float] = {}
+        tokens_seen = 0
+        for i in range(duration_steps):
+            try:
+                batch = next(it)
+            except StopIteration:
+                raise ValueError(
+                    f"batch stream exhausted at step {i}/{duration_steps}"
+                ) from None
+            tokens_seen += int(np.prod(batch.shape))
+            self.state, metrics = self._train_step(self.state, batch)
+            if (log_every and (i + 1) % log_every == 0) or i == duration_steps - 1:
+                metrics = {k: float(v) for k, v in metrics.items()}
+                losses.append(metrics["loss"])
+                last_metrics = metrics
+                if callback:
+                    callback(i, metrics)
+        jax.block_until_ready(self.state.step)
+        dt = time.monotonic() - t0
+        return {
+            **last_metrics,
+            "client/fit_time": dt,
+            "client/fit_set_parameters_time": self._last_set_time,
+            "client/steps": float(duration_steps),
+            "client/tokens_per_sec": tokens_seen / dt if dt > 0 else 0.0,
+            "client/final_loss": losses[-1] if losses else float("nan"),
+            "client/lr": float(self.lr_schedule(self.step - 1)),
+        }
+
+    def evaluate(self, batches: Iterable[np.ndarray], max_batches: int = 0) -> dict[str, float]:
+        """Mean CE over the eval stream (reference: ``llm_eval``,
+        ``llm_client_functions.py:231-353``)."""
+        t0 = time.monotonic()
+        total_ce, total_tok = 0.0, 0
+        for i, batch in enumerate(batches):
+            if max_batches and i >= max_batches:
+                break
+            ce_sum, n = self._eval_step(self.state.params, batch)
+            total_ce += float(ce_sum)
+            total_tok += int(n)
+        if total_tok == 0:
+            raise ValueError("evaluate: empty eval stream")
+        loss = total_ce / total_tok
+        return {
+            "eval/loss": loss,
+            "eval/perplexity": float(np.exp(min(loss, 30.0))),
+            "eval/tokens": float(total_tok),
+            "eval/time": time.monotonic() - t0,
+        }
+
+    # ------------------------------------------------------------------
+    # parameter plane (round boundaries)
+    # ------------------------------------------------------------------
+
+    @property
+    def step(self) -> int:
+        return int(self.state.step)
+
+    def get_parameters(self) -> tuple[ParamsMetadata, list[np.ndarray]]:
+        """Gather sharded params to host as the canonical flat list
+        (reference: ``get_trainable_params_dict`` with summon_full_params,
+        ``photon/utils.py:247-319`` — here XLA gathers, codec orders)."""
+        return params_to_ndarrays(self.state.params)
+
+    def set_parameters(self, metadata: ParamsMetadata, arrays: list[np.ndarray]) -> None:
+        """Scatter a flat ndarray list into the sharded state (reference:
+        ``set_trainer_params_from_ndarrays``, ``photon/utils.py:481-540``)."""
+        t0 = time.monotonic()
+        new_params = params_from_ndarrays(self.state.params, metadata, arrays)
+        new_params = jax.tree.map(
+            lambda leaf, sh: jax.device_put(np.asarray(leaf), sh),
+            new_params,
+            self._shardings.params,
+        )
+        self.state = self.state.replace(params=new_params)
+        self._last_set_time = time.monotonic() - t0
+
+    def reset_optimizer(self) -> None:
+        """Drop optimizer state, keep params/step (reference reset knob:
+        ``load_ignore_keys`` optimizer globs, ``clients/utils.py:229-238``)."""
+        opt_state = self.tx.init(jax.tree.map(np.asarray, self.state.params))
+        opt_state = jax.tree.map(
+            lambda leaf, sh: jax.device_put(leaf, sh), opt_state, self._shardings.opt_state
+        )
+        self.state = self.state.replace(opt_state=opt_state)
+
+    def set_step(self, step: int) -> None:
+        """Inject cumulative server steps into the local step counter AND the
+        optimizer's internal ``count`` (which drives the lr schedule and
+        ADOPT/Adam bias correction) so training continues mid-schedule across
+        rounds (reference: ``server_steps_cumulative`` → optimizer step
+        injection, ``clients/utils.py:332-341``)."""
+        new_opt = _set_opt_count(self.state.opt_state, step)
+        self.state = self.state.replace(
+            step=jnp.asarray(step, jnp.int32), opt_state=new_opt
+        )

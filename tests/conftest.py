@@ -1,0 +1,19 @@
+"""Test harness: fake an 8-device TPU-like mesh on CPU.
+
+SURVEY.md §4: the reference ships no tests; we build the pyramid ourselves.
+Multi-chip behavior is tested on a virtual CPU device mesh
+(``xla_force_host_platform_device_count``), per the driver's contract.
+"""
+
+import os
+
+# force CPU: the env may preset JAX_PLATFORMS to the (single, tunneled) TPU
+# chip, which tests must never contend for
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,61 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.codec import (
+    ParamsMetadata,
+    flatten_params,
+    params_from_ndarrays,
+    params_to_ndarrays,
+    unflatten_params,
+)
+
+
+def _tree():
+    return {
+        "wte": {"embedding": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)},
+        "blocks": {"block": {"wqkv": {"kernel": jnp.ones((3, 4), jnp.float32)}}},
+        "ln_f": {"scale": jnp.zeros((3,), jnp.float32)},
+    }
+
+
+def test_flatten_deterministic_sorted():
+    names, leaves = flatten_params(_tree())
+    assert names == sorted(names)
+    names2, _ = flatten_params(_tree())
+    assert names == names2
+
+
+def test_roundtrip():
+    tree = _tree()
+    meta, arrays = params_to_ndarrays(tree)
+    assert meta.n_arrays == 3
+    rebuilt = params_from_ndarrays(tree, meta, arrays)
+    for a, b in zip(flatten_params(tree)[1], flatten_params(rebuilt)[1]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_metadata_json_and_bounds():
+    meta, arrays = params_to_ndarrays(_tree())
+    meta2 = ParamsMetadata.from_json(meta.to_json())
+    assert meta2 == meta
+    assert meta.bounds[-1] == meta.total_bytes
+    assert meta.total_bytes == sum(a.nbytes for a in arrays)
+
+
+def test_validation_catches_shape_mismatch():
+    tree = _tree()
+    meta, arrays = params_to_ndarrays(tree)
+    bad = list(arrays)
+    bad[0] = np.zeros((5, 5), np.float32)
+    with pytest.raises(ValueError):
+        params_from_ndarrays(tree, meta, bad)
+
+
+def test_unflatten_preserves_structure():
+    tree = _tree()
+    _, leaves = flatten_params(tree)
+    rebuilt = unflatten_params(tree, [np.asarray(l) * 2 for l in leaves])
+    names, new_leaves = flatten_params(rebuilt)
+    for old, new in zip(leaves, new_leaves):
+        np.testing.assert_allclose(np.asarray(old) * 2, np.asarray(new))

@@ -1,0 +1,55 @@
+import pytest
+
+from photon_tpu.config import Config, list_presets, load_preset
+
+
+def test_roundtrip_yaml(tmp_path):
+    cfg = Config()
+    cfg.fl.n_rounds = 7
+    cfg.model.d_model = 256
+    p = tmp_path / "config.yaml"
+    cfg.to_yaml(p)
+    cfg2 = Config.from_yaml(p)
+    assert cfg2.fl.n_rounds == 7
+    assert cfg2.model.d_model == 256
+    assert cfg2.to_dict() == cfg.to_dict()
+
+
+def test_presets_load_and_validate():
+    names = list_presets()
+    assert "mpt-125m" in names and "mpt-1b" in names and "mpt-3b" in names and "mpt-7b" in names
+    c125 = load_preset("mpt-125m")
+    assert c125.model.d_model == 768
+    assert c125.model.n_layers == 12
+    assert c125.optimizer.name == "adopt"
+    assert c125.train.global_batch_size == 256
+    c1b = load_preset("mpt-1b")
+    assert c1b.model.d_model == 2048
+    assert c1b.model.d_head == 128
+    assert c1b.model.remat
+
+
+def test_preset_overrides():
+    cfg = load_preset("mpt-125m", fl={"n_rounds": 3}, run_uuid="abc")
+    assert cfg.fl.n_rounds == 3
+    assert cfg.run_uuid == "abc"
+
+
+def test_validation_errors():
+    cfg = Config()
+    cfg.fl.n_clients_per_round = 100
+    with pytest.raises(ValueError):
+        cfg.validate()
+    cfg = Config()
+    cfg.fl.strategy_name = "bogus"
+    with pytest.raises(ValueError):
+        cfg.validate()
+    with pytest.raises(ValueError):
+        Config.from_dict({"nonexistent_key": 1})
+
+
+def test_json_roundtrip():
+    cfg = Config()
+    cfg.optimizer.betas = (0.8, 0.95)
+    cfg2 = Config.from_json(cfg.to_json())
+    assert cfg2.optimizer.betas == (0.8, 0.95)

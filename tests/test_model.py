@@ -1,0 +1,75 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.config.schema import ModelConfig
+from photon_tpu.models.mpt import MPTModel, init_params
+
+TINY = ModelConfig(
+    name="tiny",
+    d_model=64,
+    n_layers=2,
+    n_heads=4,
+    max_seq_len=64,
+    vocab_size=128,
+    attn_impl="xla",
+    compute_dtype="float32",
+)
+
+
+def test_forward_shapes_and_dtype():
+    params = init_params(TINY, seed=0)
+    model = MPTModel(TINY)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, TINY.vocab_size)
+    logits = model.apply({"params": params}, tokens)
+    assert logits.shape == (2, 16, TINY.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_params_stacked_layers():
+    params = init_params(TINY, seed=0)
+    kernel = params["blocks"]["block"]["wqkv"]["kernel"]
+    assert kernel.shape == (TINY.n_layers, TINY.d_model, 3 * TINY.d_model)
+
+
+def test_causality():
+    """Changing a future token must not change past logits."""
+    params = init_params(TINY, seed=0)
+    model = MPTModel(TINY)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 16), 0, TINY.vocab_size)
+    logits1 = model.apply({"params": params}, tokens)
+    tokens2 = tokens.at[0, 10].set((tokens[0, 10] + 1) % TINY.vocab_size)
+    logits2 = model.apply({"params": params}, tokens2)
+    np.testing.assert_allclose(
+        np.asarray(logits1[0, :10]), np.asarray(logits2[0, :10]), rtol=1e-5, atol=1e-5
+    )
+    assert not np.allclose(np.asarray(logits1[0, 10]), np.asarray(logits2[0, 10]))
+
+
+def test_bf16_compute_dtype_runs():
+    cfg = ModelConfig(**{**TINY.__dict__, "compute_dtype": "bfloat16"})
+    params = init_params(cfg, seed=0)
+    model = MPTModel(cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    logits = model.apply({"params": params}, tokens)
+    assert logits.dtype == jnp.float32  # logits cast back to fp32
+    # params stay fp32 masters
+    assert params["wte"]["embedding"].dtype == jnp.float32
+
+
+def test_remat_matches_no_remat():
+    cfg_r = ModelConfig(**{**TINY.__dict__, "remat": True})
+    params = init_params(TINY, seed=0)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (1, 16), 0, TINY.vocab_size)
+    out_a = MPTModel(TINY).apply({"params": params}, tokens)
+    out_b = MPTModel(cfg_r).apply({"params": params}, tokens)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), rtol=1e-5, atol=1e-5)
+
+
+def test_125m_param_count():
+    cfg = ModelConfig()  # defaults are the 125m shape
+    params = jax.eval_shape(lambda: init_params(cfg, seed=0))
+    n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    # ~124M with tied embeddings (wte 50368*768 + wpe 2048*768 + 12 blocks)
+    assert 1.1e8 < n < 1.4e8

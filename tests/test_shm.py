@@ -1,0 +1,125 @@
+"""shm plane tests: codec roundtrip, commit protocol, cross-process hand-off
+(reference behavioral oracle: single-writer/single-reader + spin-wait,
+``photon/shm/utils.py``)."""
+
+import multiprocessing as mp
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+from photon_tpu.codec import ParamsMetadata
+from photon_tpu.shm import (
+    read_blob,
+    read_params,
+    read_scalar,
+    unlink,
+    wait_for,
+    write_blob,
+    write_params,
+    write_scalar,
+)
+from photon_tpu.shm.plane import cleanup_stale
+
+
+@pytest.fixture
+def name():
+    n = f"test-{uuid.uuid4().hex[:8]}"
+    yield n
+    unlink(n)
+
+
+def _arrays():
+    rng = np.random.default_rng(0)
+    return [
+        rng.normal(size=(4, 8)).astype(np.float32),
+        rng.integers(0, 100, (3,)).astype(np.int64),
+        rng.normal(size=(2, 2, 2)).astype(np.float32),
+    ]
+
+
+def test_params_roundtrip(name):
+    arrays = _arrays()
+    meta = ParamsMetadata.from_ndarrays(["a", "b", "c"], arrays)
+    write_params(name, meta, arrays)
+    meta2, arrays2 = read_params(name)
+    assert meta2 == meta
+    for a, b in zip(arrays, arrays2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_zero_copy_views_stable_across_rewrite(name):
+    """Rewrites swap the file atomically (rename): existing zero-copy views
+    keep the OLD snapshot; fresh reads see the new one."""
+    arrays = _arrays()
+    meta = ParamsMetadata.from_ndarrays(["a", "b", "c"], arrays)
+    write_params(name, meta, arrays)
+    _, views = read_params(name, copy=False)
+    mutated = [a * 2 for a in arrays]
+    write_params(name, meta, mutated)
+    np.testing.assert_array_equal(views[0], arrays[0])  # old mapping intact
+    _, fresh = read_params(name, copy=True)
+    np.testing.assert_array_equal(fresh[0], mutated[0])
+
+
+def test_read_before_commit_raises(name):
+    from photon_tpu.shm.plane import ShmSegment
+
+    seg = ShmSegment(name, size=64, create=True)
+    seg.close()
+    with pytest.raises(BlockingIOError):
+        read_params(name)
+
+
+def test_wait_for_timeout():
+    with pytest.raises(TimeoutError):
+        wait_for(f"never-{uuid.uuid4().hex[:6]}", timeout=0.2, poll=0.05)
+
+
+def test_blob_and_scalar(name):
+    write_blob(name, {"cid": 3, "cfg": [1, 2, 3]})
+    assert read_blob(name) == {"cid": 3, "cfg": [1, 2, 3]}
+    write_scalar(name, 42.5)
+    assert read_scalar(name) == 42.5
+
+
+def _child(name: str, q) -> None:
+    wait_for(name, timeout=20)
+    meta, arrays = read_params(name, copy=True)
+    q.put((meta.names, [float(a.sum()) for a in arrays]))
+
+
+def test_cross_process_handoff(name):
+    """Writer parent, spin-waiting reader child (the NodeManager↔Worker
+    pattern, ``node_manager_app.py:516-539``)."""
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    child = ctx.Process(target=_child, args=(name, q))
+    child.start()
+    arrays = _arrays()
+    meta = ParamsMetadata.from_ndarrays(["a", "b", "c"], arrays)
+    write_params(name, meta, arrays)
+    names, sums = q.get(timeout=30)
+    child.join(timeout=10)
+    assert names == ("a", "b", "c")
+    np.testing.assert_allclose(sums, [float(a.sum()) for a in arrays], rtol=1e-6)
+
+
+def test_cleanup_stale():
+    n = f"stale-{uuid.uuid4().hex[:8]}"
+    write_blob(n, 1)
+    assert cleanup_stale("stale-") >= 1
+    from photon_tpu.shm.plane import _path
+
+    assert not _path(n).exists()
+
+
+def test_large_params_threaded_copy(name):
+    """>64MiB payload exercises the thread-pool copy path."""
+    big = [np.arange(20_000_000, dtype=np.float32)]  # 80 MB
+    meta = ParamsMetadata.from_ndarrays(["big"], big)
+    write_params(name, meta, big)
+    _, out = read_params(name, copy=False)
+    np.testing.assert_array_equal(out[0][:5], big[0][:5])
+    np.testing.assert_array_equal(out[0][-5:], big[0][-5:])

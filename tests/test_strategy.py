@@ -1,0 +1,171 @@
+"""Golden-value tests for aggregation + server optimizers (SURVEY.md §7.5:
+"golden-value unit tests against hand-computed rounds")."""
+
+import numpy as np
+import pytest
+
+from photon_tpu.config.schema import FLConfig
+from photon_tpu.strategy import (
+    ClientResult,
+    FedAdam,
+    FedAvgEff,
+    FedMom,
+    FedNesterov,
+    FedYogi,
+    aggregate_inplace,
+    dispatch_strategy,
+    weighted_loss_avg,
+)
+from photon_tpu.strategy.metrics import GradientNoiseScale
+
+
+def arrs(*vals):
+    return [np.full((2, 2), v, np.float32) for v in vals]
+
+
+def test_aggregate_inplace_weighted_mean():
+    results = [(arrs(1.0), 1), (arrs(4.0), 3)]
+    avg, n = aggregate_inplace(iter(results))
+    assert n == 4
+    np.testing.assert_allclose(avg[0], np.full((2, 2), (1 * 1 + 4 * 3) / 4), rtol=1e-6)
+
+
+def test_aggregate_inplace_matches_direct_mean_many():
+    rng = np.random.default_rng(0)
+    payloads = [([rng.normal(size=(3, 5)).astype(np.float32)], int(n)) for n in rng.integers(1, 100, 12)]
+    avg, n_tot = aggregate_inplace(iter(payloads))
+    direct = sum(a[0].astype(np.float64) * n for a, n in payloads) / sum(n for _, n in payloads)
+    np.testing.assert_allclose(avg[0], direct, rtol=1e-5)
+
+
+def test_aggregate_rejects_empty_and_bad_counts():
+    with pytest.raises(ValueError):
+        aggregate_inplace(iter([]))
+    with pytest.raises(ValueError):
+        aggregate_inplace(iter([(arrs(1.0), 0)]))
+
+
+def _round(strategy, client_vals, server_val=1.0, n_samples=None, rnd=1):
+    strategy.initialize(arrs(server_val)) if strategy.current_parameters is None else None
+    n_samples = n_samples or [1] * len(client_vals)
+    results = (
+        ClientResult(cid=i, arrays=arrs(v), n_samples=n)
+        for i, (v, n) in enumerate(zip(client_vals, n_samples))
+    )
+    params, metrics = strategy.aggregate_fit(rnd, results)
+    return params[0][0, 0], metrics
+
+
+def test_fedavg_lr1_is_plain_average():
+    s = FedAvgEff(server_learning_rate=1.0)
+    val, _ = _round(s, [0.0, 2.0])  # avg=1.0, g = 1-1 = 0 → x=1... use server 4
+    s2 = FedAvgEff(server_learning_rate=1.0)
+    s2.initialize(arrs(4.0))
+    val, _ = _round(s2, [0.0, 2.0])
+    # g = 4 - 1 = 3; x = 4 - 3 = 1 = the average
+    np.testing.assert_allclose(val, 1.0, rtol=1e-6)
+
+
+def test_fedavg_halved_lr():
+    s = FedAvgEff(server_learning_rate=0.5)
+    s.initialize(arrs(4.0))
+    val, _ = _round(s, [0.0, 2.0])
+    np.testing.assert_allclose(val, 4.0 - 0.5 * 3.0, rtol=1e-6)  # 2.5
+
+
+def test_client_count_scaling():
+    s = FedAvgEff(server_learning_rate=0.1, client_count_scaling="linear")
+    assert s.effective_lr(4) == pytest.approx(0.4)
+    s2 = FedAvgEff(server_learning_rate=0.1, client_count_scaling="sqrt")
+    assert s2.effective_lr(4) == pytest.approx(0.2)
+
+
+def test_nesterov_two_rounds_golden():
+    # μ=0.5, η=1. Round1: avg=0 from x=1 → g=1; m=0.5*0+1=1; step=g+μm=1.5; x=-0.5
+    # Round2: clients at -0.5 → g = x - avg = 0 → m=0.5; step=0+0.25... compute:
+    s = FedNesterov(server_learning_rate=1.0, server_momentum=0.5)
+    s.initialize(arrs(1.0))
+    v1, _ = _round(s, [0.0, 0.0], rnd=1)
+    np.testing.assert_allclose(v1, -0.5, rtol=1e-6)
+    # round 2: clients return -1.5 (avg), g = -0.5 - (-1.5) = 1.0
+    v2, _ = _round(s, [-1.5, -1.5], rnd=2)
+    # m = 0.5*1 + 1 = 1.5; step = 1 + 0.5*1.5 = 1.75; x = -0.5 - 1.75 = -2.25
+    np.testing.assert_allclose(v2, -2.25, rtol=1e-6)
+
+
+def test_fedmom_golden():
+    s = FedMom(server_learning_rate=1.0, server_momentum=0.9)
+    s.initialize(arrs(1.0))
+    v1, _ = _round(s, [0.0], rnd=1)  # g=1, m=1, x = 0
+    np.testing.assert_allclose(v1, 0.0, atol=1e-7)
+    v2, _ = _round(s, [-1.0], rnd=2)  # g = 0-(-1)=1; m=0.9+1=1.9; x=0-1.9
+    np.testing.assert_allclose(v2, -1.9, rtol=1e-6)
+
+
+def test_fedadam_first_step_golden():
+    # t=1: m=(1-b1)g /(1-b1) = g; v=(1-b2)g²/(1-b2)=g²; x -= lr·g/(|g|+tau) = sign
+    s = FedAdam(server_learning_rate=0.1, server_beta_1=0.9, server_beta_2=0.99, server_tau=0.0)
+    s.initialize(arrs(1.0))
+    v1, _ = _round(s, [0.5], rnd=1)  # g=0.5 → step = 0.1 * 0.5/0.5 = 0.1
+    np.testing.assert_allclose(v1, 0.9, rtol=1e-6)
+
+
+def test_fedyogi_second_moment_sign():
+    s = FedYogi(server_learning_rate=0.1, server_beta_1=0.0, server_beta_2=0.99, server_tau=0.0)
+    s.initialize(arrs(1.0))
+    # v starts 0; g²>0 ⇒ sign(0-g²)=-1 ⇒ v = (1-b2)·g², same as adam's first step
+    v1, _ = _round(s, [0.5], rnd=1)
+    np.testing.assert_allclose(v1, 0.9, rtol=1e-6)
+
+
+def test_adaptive_state_checkpoint_roundtrip():
+    s = FedAdam(server_learning_rate=0.1)
+    s.initialize(arrs(1.0))
+    _round(s, [0.5], rnd=1)
+    ckpt_state = s.state_for_checkpoint()
+    ckpt_params = [a.copy() for a in s.current_parameters]
+
+    s2 = FedAdam(server_learning_rate=0.1)
+    s2.initialize(ckpt_params, ckpt_state)
+    assert s2._t == 1
+    v_a, _ = _round(s, [0.2], rnd=2)
+    v_b, _ = _round(s2, [0.2], rnd=2)
+    np.testing.assert_allclose(v_a, v_b, rtol=1e-6)
+
+
+def test_dispatcher_covers_all():
+    for name in ("fedavg", "nesterov", "fedmom", "fedadam", "fedyogi"):
+        s = dispatch_strategy(FLConfig(strategy_name=name))
+        assert s.name == name
+
+
+def test_weighted_loss_avg():
+    assert weighted_loss_avg([(1, 2.0), (3, 4.0)]) == pytest.approx((2 + 12) / 4)
+
+
+def test_metrics_weighted_and_telemetry():
+    s = FedAvgEff(server_learning_rate=1.0)
+    s.initialize(arrs(1.0))
+    results = (
+        ClientResult(cid=i, arrays=arrs(v), n_samples=n, metrics={"loss": loss})
+        for i, (v, n, loss) in enumerate([(0.0, 1, 2.0), (2.0, 3, 4.0)])
+    )
+    _, metrics = s.aggregate_fit(1, results)
+    assert metrics["loss"] == pytest.approx(3.5)
+    assert metrics["server/n_clients"] == 2
+    assert "server/pseudo_grad_norm" in metrics
+
+
+def test_gradient_noise_scale_uniform_grads():
+    """Identical client grads ⇒ zero noise ⇒ S≈0."""
+    gns = GradientNoiseScale(ema_alpha=0.0)
+    out = gns.update([4.0, 4.0], [10, 10], aggregate_sq_norm=4.0, total_samples=20)
+    assert out["server/gns_trace_est"] == pytest.approx(0.0, abs=1e-9)
+    assert out["server/gradient_noise_scale"] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_gradient_noise_scale_positive():
+    gns = GradientNoiseScale(ema_alpha=0.0)
+    # small-batch norms larger than big-batch ⇒ positive noise scale
+    out = gns.update([5.0, 5.0], [10, 10], aggregate_sq_norm=3.0, total_samples=20)
+    assert out["server/gradient_noise_scale"] > 0
