@@ -1,9 +1,21 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test bench
+.PHONY: test bench native clean
+# native build is best-effort: the package degrades to numpy fallbacks when
+# the .so is absent, so tests must run even without a C++ toolchain
 test:
+	-$(MAKE) native
 	PALLAS_AXON_POOL_IPS= python -m pytest tests/ -x -q
 
 bench:
+	-$(MAKE) native
 	python bench.py
+
+native: native/libphoton_native.so
+
+native/libphoton_native.so: native/photon_native.cpp
+	g++ -O3 -march=native -shared -fPIC -pthread -std=c++17 -o $@ $<
+
+clean:
+	rm -f native/libphoton_native.so

@@ -1,0 +1,106 @@
+"""Headline benchmark: MPT-125M training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The recipe matches the reference's 125M training config
+(conf/llm_config/mpt-125m.yaml:18-92): d768/12L/12H, seq 2048, vocab 50368,
+bf16 compute, ADOPT lr 6e-4, grad clip 1.0, flash attention (Pallas here).
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+denominator is a derived A100 estimate for the same recipe: ~0.97 GFLOP/token
+(6N non-embedding + attention + tied lm_head) at 35% MFU of 312 TFLOPs bf16
+≈ 110k tokens/sec/GPU. >1.0 means faster than that estimate per chip.
+
+Env knobs: PHOTON_BENCH_STEPS (timed steps, default 8),
+PHOTON_BENCH_MICROBATCH (rows per scan step, default 8),
+PHOTON_BENCH_GBS (global batch rows, default 16).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+A100_EST_TOKENS_PER_SEC = 110_000.0
+
+
+def log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    # persistent compile cache: the driver re-runs this every round — only
+    # round 1 pays the full compile
+    cache_dir = pathlib.Path(__file__).parent / ".jax_cache"
+    cache_dir.mkdir(exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from photon_tpu.config.schema import Config
+    from photon_tpu.parallel.mesh import single_device_mesh
+    from photon_tpu.train.trainer import Trainer
+
+    t_boot = time.perf_counter()
+    platform = jax.devices()[0].platform
+    log(f"backend up in {time.perf_counter() - t_boot:.1f}s: {jax.devices()[0]}")
+    on_tpu = platform == "tpu"
+
+    cfg = Config()
+    cfg.model.attn_impl = "pallas" if on_tpu else "xla"
+    if not on_tpu:  # smoke-scale fallback so the bench also runs on CPU
+        cfg.model.n_layers = 2
+        cfg.model.max_seq_len = 256
+
+    seq = cfg.model.max_seq_len
+    micro = int(os.environ.get("PHOTON_BENCH_MICROBATCH", "8"))
+    gbs = int(os.environ.get("PHOTON_BENCH_GBS", "16"))
+    cfg.train.device_microbatch_size = micro
+    cfg.train.global_batch_size = gbs
+    cfg.validate()
+
+    t0 = time.perf_counter()
+    trainer = Trainer(cfg, mesh=single_device_mesh())
+    log(f"trainer built in {time.perf_counter() - t0:.1f}s (n_micro={trainer._n_micro})")
+
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return rng.integers(0, cfg.model.vocab_size, (gbs, seq), dtype=np.int32)
+
+    t0 = time.perf_counter()
+    trainer.state, _ = trainer._train_step(trainer.state, batch())
+    jax.block_until_ready(trainer.state.step)
+    log(f"compile+step1 in {time.perf_counter() - t0:.1f}s")
+    trainer.state, _ = trainer._train_step(trainer.state, batch())
+    jax.block_until_ready(trainer.state.step)
+
+    n_steps = int(os.environ.get("PHOTON_BENCH_STEPS", "8" if on_tpu else "2"))
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        trainer.state, m = trainer._train_step(trainer.state, batch())
+    jax.block_until_ready(trainer.state.step)
+    dt = time.perf_counter() - t0
+
+    toks_per_sec = n_steps * gbs * seq / dt
+    log(f"{n_steps} steps in {dt:.2f}s, loss={float(m['loss']):.3f}")
+    print(
+        json.dumps(
+            {
+                "metric": "mpt125m_train_tokens_per_sec_per_chip",
+                "value": round(toks_per_sec, 1),
+                "unit": "tokens/sec",
+                "vs_baseline": round(toks_per_sec / A100_EST_TOKENS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""Two-plane checkpointing (reference: SURVEY.md §5 checkpoint/resume):
+server round checkpoints + client local-step checkpoints, over a pluggable
+object store."""
+
+from photon_tpu.checkpoint.client import ClientCheckpointManager
+from photon_tpu.checkpoint.serialization import (
+    arrays_to_npz,
+    bytes_to_state,
+    npz_to_arrays,
+    state_to_bytes,
+)
+from photon_tpu.checkpoint.server import ServerCheckpointManager
+from photon_tpu.checkpoint.store import FileStore, ObjectStore, make_store
+
+__all__ = [
+    "ClientCheckpointManager",
+    "ServerCheckpointManager",
+    "FileStore",
+    "ObjectStore",
+    "make_store",
+    "arrays_to_npz",
+    "npz_to_arrays",
+    "state_to_bytes",
+    "bytes_to_state",
+]

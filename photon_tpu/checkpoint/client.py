@@ -1,0 +1,95 @@
+"""Client (per-cid) local-step checkpoints with skip-if-done semantics.
+
+Reference behavior (``photon/clients/llm_config_functions.py:642-764``):
+Composer writes ``client_{cid}/ep{E}-ba{B}-rank{R}.pt``; before a round the
+client scans for the latest checkpoint at-or-below the target step, loads it,
+and — if the *post-round* checkpoint already exists — skips the round
+entirely (mid-round resume after a crash).
+
+Here a client checkpoint is ``{run_uuid}/client_{cid}/ba{step}/`` holding the
+full TrainState as npz blobs (params, optimizer state leaves, step) plus the
+data-loader state — enough to reproduce the training trajectory exactly.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import numpy as np
+
+from photon_tpu.checkpoint.serialization import (
+    arrays_to_npz,
+    bytes_to_state,
+    npz_to_arrays,
+    state_to_bytes,
+)
+from photon_tpu.checkpoint.store import ObjectStore
+from photon_tpu.codec import ParamsMetadata
+
+
+class ClientCheckpointManager:
+    def __init__(self, store: ObjectStore, run_uuid: str) -> None:
+        self.store = store
+        self.run_uuid = run_uuid
+
+    def _prefix(self, cid: int, step: int) -> str:
+        return f"{self.run_uuid}/client_{cid}/ba{step}"
+
+    def save(
+        self,
+        cid: int,
+        step: int,
+        params_meta: ParamsMetadata,
+        params: list[np.ndarray],
+        opt_meta: ParamsMetadata | None = None,
+        opt_arrays: list[np.ndarray] | None = None,
+        extra_state: dict[str, Any] | None = None,
+    ) -> None:
+        prefix = self._prefix(cid, step)
+        self.store.put(f"{prefix}/params.npz", arrays_to_npz(params_meta, params))
+        if opt_meta is not None and opt_arrays is not None:
+            self.store.put(f"{prefix}/opt.npz", arrays_to_npz(opt_meta, opt_arrays))
+        # done-marker written last → a checkpoint is only "done" when complete
+        self.store.put(f"{prefix}/state.bin", state_to_bytes({"step": step, **(extra_state or {})}))
+
+    def steps(self, cid: int) -> list[int]:
+        out = set()
+        for key in self.store.list(f"{self.run_uuid}/client_{cid}"):
+            m = re.search(r"/ba(\d+)/state\.bin$", "/" + key)
+            if m:
+                out.add(int(m.group(1)))
+        return sorted(out)
+
+    def has(self, cid: int, step: int) -> bool:
+        return self.store.exists(f"{self._prefix(cid, step)}/state.bin")
+
+    def latest_at_most(self, cid: int, step: int) -> int | None:
+        """Latest checkpointed step ≤ ``step`` (reference: scan for the
+        newest ``ep{E}-ba{B}`` not past the target, ``:642-764``)."""
+        candidates = [s for s in self.steps(cid) if s <= step]
+        return max(candidates) if candidates else None
+
+    def should_skip_round(self, cid: int, target_step: int) -> bool:
+        """True iff the post-round checkpoint already exists — the round was
+        fully trained before a crash; re-use it instead of re-training."""
+        return self.has(cid, target_step)
+
+    def load(
+        self, cid: int, step: int
+    ) -> tuple[ParamsMetadata, list[np.ndarray], tuple[ParamsMetadata, list[np.ndarray]] | None, dict]:
+        prefix = self._prefix(cid, step)
+        pm, pa = npz_to_arrays(self.store.get(f"{prefix}/params.npz"))
+        opt = None
+        if self.store.exists(f"{prefix}/opt.npz"):
+            opt = npz_to_arrays(self.store.get(f"{prefix}/opt.npz"))
+        state = bytes_to_state(self.store.get(f"{prefix}/state.bin"))
+        return pm, pa, opt, state
+
+    def cleanup(self, cid: int, keep: int) -> list[int]:
+        steps = self.steps(cid)
+        deleted = []
+        for s in steps[:-keep] if keep > 0 else []:
+            self.store.delete(self._prefix(cid, s))
+            deleted.append(s)
+        return deleted

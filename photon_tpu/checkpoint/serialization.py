@@ -1,0 +1,51 @@
+"""Array-list and state blob (de)serialization for checkpoints.
+
+Reference formats (``photon/server/s3_utils.py:348-548``): params/momenta as
+``.npz`` files, server state as a pickled ``state.bin``. Same shapes here:
+``.npz`` keeps the flat-list + names contract of the codec, pickle carries
+small control state (history, client_state, round counters) — never tensors.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any
+
+import numpy as np
+
+from photon_tpu.codec import ParamsMetadata
+
+
+def arrays_to_npz(metadata: ParamsMetadata, arrays: list[np.ndarray]) -> bytes:
+    """Order-preserving: arrays are stored under indexed keys plus a
+    ``__names__`` manifest, because npz key iteration is alphabetical and
+    payload order is load-bearing (momenta-extended payloads are
+    ``[params|m1|m2]``, not name-sorted)."""
+    import json
+
+    metadata.validate_arrays(arrays)
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        __names__=np.frombuffer(json.dumps(list(metadata.names)).encode(), np.uint8),
+        **{f"arr_{i:06d}": a for i, a in enumerate(arrays)},
+    )
+    return buf.getvalue()
+
+
+def npz_to_arrays(data: bytes) -> tuple[ParamsMetadata, list[np.ndarray]]:
+    import json
+
+    with np.load(io.BytesIO(data)) as z:
+        names = tuple(json.loads(bytes(z["__names__"]).decode()))
+        arrays = [z[f"arr_{i:06d}"] for i in range(len(names))]
+    return ParamsMetadata.from_ndarrays(names, arrays), arrays
+
+
+def state_to_bytes(state: dict[str, Any]) -> bytes:
+    return pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def bytes_to_state(data: bytes) -> dict[str, Any]:
+    return pickle.loads(data)

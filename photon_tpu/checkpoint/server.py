@@ -1,0 +1,152 @@
+"""Server round checkpoints: save/resume/GC/cross-run import.
+
+Reference semantics (``photon/server/s3_utils.py``):
+- layout ``{run_uuid}/server/{round}/``: ``state.bin`` (pickled control
+  state: history, client_state, server_steps_cumulative, rng round counter) +
+  ``current_server_parameters.npz`` + one ``{key}.npz`` per strategy
+  ``state_keys`` (``:348-548``);
+- a round is *valid* only if parameters and every declared state key are
+  present (``:215-272``) — partial uploads are never resumed from;
+- ``resume_round`` negative indexes from the latest valid round
+  (``:1261-1318``);
+- GC keeps the newest N rounds (``cleanup_checkpoints :1611-1641``);
+- cross-run import copies an old run's checkpoints into a new run_uuid
+  (``copy_old_checkpoints_to_new_run :1478-1608``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from photon_tpu.checkpoint.serialization import (
+    arrays_to_npz,
+    bytes_to_state,
+    npz_to_arrays,
+    state_to_bytes,
+)
+from photon_tpu.checkpoint.store import ObjectStore
+from photon_tpu.codec import ParamsMetadata
+
+PARAMS_FILE = "current_server_parameters.npz"
+STATE_FILE = "state.bin"
+
+
+class ServerCheckpointManager:
+    def __init__(self, store: ObjectStore, run_uuid: str) -> None:
+        self.store = store
+        self.run_uuid = run_uuid
+
+    # -- keys ------------------------------------------------------------
+    def _round_prefix(self, server_round: int, run_uuid: str | None = None) -> str:
+        return f"{run_uuid or self.run_uuid}/server/{server_round}"
+
+    # -- save ------------------------------------------------------------
+    def save_round(
+        self,
+        server_round: int,
+        metadata: ParamsMetadata,
+        parameters: list[np.ndarray],
+        strategy_state: dict[str, list[np.ndarray]] | None = None,
+        server_state: dict[str, Any] | None = None,
+    ) -> None:
+        prefix = self._round_prefix(server_round)
+        # state.bin last: its presence marks the round complete only after
+        # params/momenta landed (writes are atomic per object)
+        self.store.put(f"{prefix}/{PARAMS_FILE}", arrays_to_npz(metadata, parameters))
+        for key, tensors in (strategy_state or {}).items():
+            # per-layer state aligns 1:1 with the (already canonically sorted)
+            # param names; odd-length state (e.g. FedAdam's step counter) gets
+            # zero-padded index names so npz's alphabetical order == list order
+            names = (
+                metadata.names
+                if len(tensors) == len(metadata.names)
+                else [f"{i:06d}" for i in range(len(tensors))]
+            )
+            meta = ParamsMetadata.from_ndarrays(names, tensors)
+            self.store.put(f"{prefix}/{key}.npz", arrays_to_npz(meta, tensors))
+        self.store.put(f"{prefix}/{STATE_FILE}", state_to_bytes(server_state or {}))
+
+    # -- discovery -------------------------------------------------------
+    def list_rounds(self, run_uuid: str | None = None) -> list[int]:
+        prefix = f"{run_uuid or self.run_uuid}/server"
+        rounds: set[int] = set()
+        for key in self.store.list(prefix):
+            parts = key.split("/")
+            if len(parts) >= 3 and parts[-3] == "server":
+                try:
+                    rounds.add(int(parts[-2]))
+                except ValueError:
+                    continue
+        return sorted(rounds)
+
+    def is_valid_round(
+        self, server_round: int, state_keys: tuple[str, ...] = (), run_uuid: str | None = None
+    ) -> bool:
+        prefix = self._round_prefix(server_round, run_uuid)
+        needed = [f"{prefix}/{PARAMS_FILE}", f"{prefix}/{STATE_FILE}"]
+        needed += [f"{prefix}/{k}.npz" for k in state_keys]
+        return all(self.store.exists(k) for k in needed)
+
+    def valid_rounds(self, state_keys: tuple[str, ...] = ()) -> list[int]:
+        return [r for r in self.list_rounds() if self.is_valid_round(r, state_keys)]
+
+    def resolve_resume_round(self, resume_round: int, state_keys: tuple[str, ...] = ()) -> int:
+        """Non-negative → that round (validated). Negative → index from the
+        latest valid round: −1 = latest, −2 = one before, ... (reference:
+        ``s3_utils.py:1261-1318``)."""
+        valid = self.valid_rounds(state_keys)
+        if not valid:
+            raise FileNotFoundError(f"no valid checkpoints for run {self.run_uuid!r}")
+        if resume_round >= 0:
+            if resume_round not in valid:
+                raise FileNotFoundError(
+                    f"round {resume_round} is not a valid checkpoint (valid: {valid})"
+                )
+            return resume_round
+        if -resume_round > len(valid):
+            raise FileNotFoundError(f"resume_round {resume_round} but only {len(valid)} valid")
+        return valid[resume_round]
+
+    # -- load ------------------------------------------------------------
+    def load_round(
+        self, server_round: int, state_keys: tuple[str, ...] = ()
+    ) -> tuple[ParamsMetadata, list[np.ndarray], dict[str, list[np.ndarray]], dict[str, Any]]:
+        prefix = self._round_prefix(server_round)
+        metadata, parameters = npz_to_arrays(self.store.get(f"{prefix}/{PARAMS_FILE}"))
+        strategy_state: dict[str, list[np.ndarray]] = {}
+        for key in state_keys:
+            _, tensors = npz_to_arrays(self.store.get(f"{prefix}/{key}.npz"))
+            strategy_state[key] = tensors
+        server_state = bytes_to_state(self.store.get(f"{prefix}/{STATE_FILE}"))
+        return metadata, parameters, strategy_state, server_state
+
+    # -- GC / import -----------------------------------------------------
+    def cleanup(self, keep: int, state_keys: tuple[str, ...] = ()) -> list[int]:
+        """Delete all but the newest ``keep`` valid rounds; invalid (partial)
+        rounds older than the newest valid one are removed too. Returns the
+        deleted round numbers."""
+        valid = self.valid_rounds(state_keys)
+        keep_set = set(valid[-keep:]) if keep > 0 else set(valid)
+        deleted = []
+        for r in self.list_rounds():
+            if r not in keep_set and (r in valid or (valid and r < valid[-1])):
+                self.store.delete(self._round_prefix(r))
+                deleted.append(r)
+        return deleted
+
+    def import_run(self, old_run_uuid: str, state_keys: tuple[str, ...] = ()) -> list[int]:
+        """Copy every valid round of ``old_run_uuid`` into this run
+        (reference: ``copy_old_checkpoints_to_new_run``)."""
+        imported = []
+        for r in self.list_rounds(old_run_uuid):
+            if not self.is_valid_round(r, state_keys, old_run_uuid):
+                continue
+            src = self._round_prefix(r, old_run_uuid)
+            dst = self._round_prefix(r)
+            for key in self.store.list(src):
+                rel = key[len(src) :].lstrip("/")
+                self.store.copy(key, f"{dst}/{rel}")
+            imported.append(r)
+        return imported

@@ -1,0 +1,117 @@
+"""Object store abstraction for checkpoints and bulk parameter transport.
+
+Reference role: boto3/S3 via Composer's RemoteUploaderDownloader
+(``photon/server/s3_utils.py``) — durable cross-host storage doubling as the
+parameter transport plane. Here the interface is a minimal key-value blob
+store; the filesystem backend covers single-host and NFS/GCS-fuse mounts, and
+an S3-style backend can slot in behind the same interface (boto3 is not baked
+into the image, so the remote backend is gated).
+
+Writes are atomic (temp file + rename) so readers polling ``exists`` never
+observe partial objects — the property the reference gets from S3's atomic
+PUT and relies on in ``validate_given_remote_path`` polling
+(``s3_utils.py:812-864``).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import shutil
+import time
+from typing import Iterable
+
+
+class ObjectStore:
+    """Key → bytes. Keys are '/'-separated paths."""
+
+    def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> list[str]:
+        raise NotImplementedError
+
+    def copy(self, src_key: str, dst_key: str) -> None:
+        self.put(dst_key, self.get(src_key))
+
+    # -- conveniences ----------------------------------------------------
+    def put_file(self, key: str, path: str | pathlib.Path) -> None:
+        self.put(key, pathlib.Path(path).read_bytes())
+
+    def get_to_file(self, key: str, path: str | pathlib.Path) -> None:
+        p = pathlib.Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(self.get(key))
+
+    def wait_for(self, key: str, timeout: float = 120.0, poll: float = 0.1) -> None:
+        """Poll until ``key`` exists (reference: S3 visibility polling,
+        ``s3_utils.py:812-864``)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.exists(key):
+                return
+            time.sleep(poll)
+        raise TimeoutError(f"object {key!r} not visible after {timeout}s")
+
+
+class FileStore(ObjectStore):
+    """Filesystem-backed store with atomic writes."""
+
+    def __init__(self, root: str | pathlib.Path) -> None:
+        self.root = pathlib.Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, key: str) -> pathlib.Path:
+        p = (self.root / key).resolve()
+        if not str(p).startswith(str(self.root.resolve())):
+            raise ValueError(f"key escapes store root: {key!r}")
+        return p
+
+    def put(self, key: str, data: bytes) -> None:
+        p = self._path(key)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.parent / f".{p.name}.tmp-{os.getpid()}"
+        tmp.write_bytes(data)
+        os.rename(tmp, p)
+
+    def get(self, key: str) -> bytes:
+        return self._path(key).read_bytes()
+
+    def exists(self, key: str) -> bool:
+        return self._path(key).is_file()
+
+    def delete(self, key: str) -> None:
+        p = self._path(key)
+        if p.is_file():
+            p.unlink()
+        elif p.is_dir():
+            shutil.rmtree(p)
+
+    def list(self, prefix: str) -> list[str]:
+        base = self._path(prefix) if prefix else self.root
+        if not base.exists():
+            return []
+        out: Iterable[pathlib.Path] = base.rglob("*") if base.is_dir() else [base]
+        root = self.root.resolve()
+        return sorted(str(p.resolve().relative_to(root)) for p in out if p.is_file())
+
+
+def make_store(uri: str) -> ObjectStore:
+    """``/path`` or ``file:///path`` → FileStore; ``s3://`` reserved."""
+    if uri.startswith("file://"):
+        return FileStore(uri[len("file://") :])
+    if uri.startswith("s3://"):
+        raise NotImplementedError(
+            "s3:// backend requires boto3 (not in this image); mount the bucket "
+            "and use a file path instead"
+        )
+    return FileStore(uri)

@@ -34,6 +34,7 @@ class StrategyName(str, enum.Enum):
 class AttnImpl(str, enum.Enum):
     PALLAS = "pallas"  # blockwise flash attention kernel (TPU)
     XLA = "xla"  # pure-XLA reference path (reference's ``attn_impl: torch``)
+    RING = "ring"  # ring/context-parallel attention over the sequence mesh axis
 
 
 @dataclass
@@ -77,6 +78,9 @@ class OptimizerConfig:
     eps: float = 1.0e-6
     weight_decay: float = 0.0
     grad_clip_norm: float = 1.0
+    # param-path regexes to freeze (reference: ``freeze_blocks``,
+    # ``photon/utils.py:322-387``); e.g. [r"blocks/.*ln_1"]
+    freeze_patterns: list = field(default_factory=list)
 
 
 @dataclass
@@ -181,6 +185,9 @@ class FLConfig:
     ignore_failed_rounds: bool = False
     eval_interval_rounds: int = 0
     sample_seed: int = 1234
+    # per-round client config knobs (reference FitConfig: reset_optimizer,
+    # reset_dataset_state, client_checkpoints, ... — ``clients/configs.py:55-214``)
+    fit_config: dict = field(default_factory=dict)
 
 
 @dataclass

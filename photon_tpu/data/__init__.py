@@ -1,0 +1,27 @@
+"""Dataset pipeline: PTS shard format, resumable streaming loader, corpus
+conversion, unigram frequency dictionaries (reference: ``photon/dataset/`` +
+mosaicml-streaming)."""
+
+from photon_tpu.data.loader import LoaderState, StreamingLoader, make_synthetic_dataset
+from photon_tpu.data.shard_format import ShardedDataset, ShardWriter, token_dtype
+from photon_tpu.data.unigram import (
+    count_tokens,
+    load_freq_dict,
+    merge_freq_dicts,
+    probability_tensor,
+    save_freq_dict,
+)
+
+__all__ = [
+    "LoaderState",
+    "StreamingLoader",
+    "ShardedDataset",
+    "ShardWriter",
+    "token_dtype",
+    "make_synthetic_dataset",
+    "count_tokens",
+    "load_freq_dict",
+    "merge_freq_dicts",
+    "probability_tensor",
+    "save_freq_dict",
+]

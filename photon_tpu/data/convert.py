@@ -1,0 +1,165 @@
+"""Corpus → per-client PTS shards conversion (the offline dataset pipeline).
+
+Role parity with ``photon/dataset/convert_dataset_hf.py``: tokenize documents,
+pack the token stream into fixed ``seq_len`` samples, split them across
+``n_clients`` (``client_{i}/{split}/`` directories), and emit a per-client
+1-gram frequency json + tokenizer metadata. Sources:
+
+- Hugging Face datasets (``--hf-dataset c4 --hf-config en``) when the
+  ``datasets`` package is importable (it is not baked into every image — the
+  path is gated, reference requires it unconditionally);
+- local text / jsonl files (one doc per line; jsonl uses a ``text`` field).
+
+Packing matches the reference's ConcatTokensDataset behavior: docs are
+tokenized, an EOS token is appended to each, and the concatenated stream is
+chunked into exact ``seq_len`` rows (no padding; the remainder tail is
+dropped). Round-robin client assignment of finished samples keeps client
+shards near-equal (reference splits evenly, ``convert_dataset_hf.py:304-363``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+from typing import Iterable, Iterator
+
+import numpy as np
+
+from photon_tpu.data.shard_format import ShardWriter, ShardedDataset
+from photon_tpu.data.unigram import FREQ_FILENAME, count_tokens, save_freq_dict
+
+
+def iter_text_files(paths: list[str]) -> Iterator[str]:
+    for path in paths:
+        p = pathlib.Path(path)
+        with p.open() as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                if p.suffix == ".jsonl":
+                    doc = json.loads(line).get("text", "")
+                    if doc:
+                        yield doc
+                else:
+                    yield line
+
+
+def iter_hf_dataset(name: str, config: str | None, split: str, streaming: bool = True):
+    try:
+        import datasets  # type: ignore
+    except ImportError as e:  # pragma: no cover - env without `datasets`
+        raise RuntimeError(
+            "the `datasets` package is unavailable; use --text-files/--jsonl input"
+        ) from e
+    ds = datasets.load_dataset(name, config, split=split, streaming=streaming)
+    for row in ds:
+        yield row["text"]
+
+
+class TokenPacker:
+    """EOS-joined document stream → exact ``[seq_len]`` samples."""
+
+    def __init__(self, seq_len: int, eos_id: int) -> None:
+        self.seq_len = seq_len
+        self.eos_id = eos_id
+        self._tail = np.zeros(0, np.int64)
+
+    def pack(self, token_ids: np.ndarray) -> Iterator[np.ndarray]:
+        stream = np.concatenate([self._tail, np.asarray(token_ids, np.int64), [self.eos_id]])
+        n_full = len(stream) // self.seq_len
+        for i in range(n_full):
+            yield stream[i * self.seq_len : (i + 1) * self.seq_len]
+        self._tail = stream[n_full * self.seq_len :]
+
+
+def convert_corpus(
+    docs: Iterable[str],
+    out_dir: str | pathlib.Path,
+    tokenizer,
+    n_clients: int = 1,
+    seq_len: int = 2048,
+    split: str = "train",
+    samples_per_shard: int = 4096,
+    max_samples: int | None = None,
+) -> dict:
+    """Tokenize+pack ``docs`` and distribute samples round-robin over
+    ``client_{i}/{split}`` PTS datasets. Returns a summary dict."""
+    out = pathlib.Path(out_dir)
+    vocab = int(tokenizer.vocab_size)
+    eos = tokenizer.eos_token_id
+    if eos is None:
+        raise ValueError("tokenizer has no EOS token (reference fixes this up; see data/tokenizer.py)")
+    writers = [
+        ShardWriter(out / f"client_{i}" / split, seq_len, max(vocab, eos + 1), samples_per_shard)
+        for i in range(n_clients)
+    ]
+    packer = TokenPacker(seq_len, eos)
+    n_written = 0
+    done = False
+    for doc in docs:
+        ids = np.asarray(tokenizer.encode(doc), np.int64)
+        for sample in packer.pack(ids):
+            writers[n_written % n_clients].write(sample)
+            n_written += 1
+            if max_samples is not None and n_written >= max_samples:
+                done = True
+                break
+        if done:
+            break
+    for i, w in enumerate(writers):
+        w.close()
+        ds = ShardedDataset(out / f"client_{i}" / split)
+        save_freq_dict(out / f"client_{i}" / split / FREQ_FILENAME, count_tokens(ds))
+    summary = {
+        "n_clients": n_clients,
+        "split": split,
+        "seq_len": seq_len,
+        "vocab_size": vocab,
+        "total_samples": n_written,
+        "tokenizer": getattr(tokenizer, "name_or_path", "unknown"),
+    }
+    (out / f"conversion_{split}.json").write_text(json.dumps(summary, indent=1))
+    return summary
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description="Convert a corpus to per-client PTS shards")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--hf-dataset", help="HF dataset name (e.g. allenai/c4)")
+    src.add_argument("--text-files", nargs="+", help="local .txt/.jsonl files, one doc per line")
+    ap.add_argument("--hf-config", default=None)
+    ap.add_argument("--hf-split", default="train")
+    ap.add_argument("--tokenizer", default="gpt2")
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--n-clients", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=2048)
+    ap.add_argument("--split", default="train")
+    ap.add_argument("--max-samples", type=int, default=None)
+    ap.add_argument("--samples-per-shard", type=int, default=4096)
+    args = ap.parse_args(argv)
+
+    from photon_tpu.data.tokenizer import load_tokenizer
+
+    tok = load_tokenizer(args.tokenizer)
+    docs = (
+        iter_hf_dataset(args.hf_dataset, args.hf_config, args.hf_split)
+        if args.hf_dataset
+        else iter_text_files(args.text_files)
+    )
+    summary = convert_corpus(
+        docs,
+        args.out,
+        tok,
+        n_clients=args.n_clients,
+        seq_len=args.seq_len,
+        split=args.split,
+        samples_per_shard=args.samples_per_shard,
+        max_samples=args.max_samples,
+    )
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

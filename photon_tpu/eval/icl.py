@@ -1,0 +1,174 @@
+"""In-context-learning (ICL) evaluation harness — the Eval Gauntlet analog.
+
+Reference: llm-foundry's ICL task suite driven by photon's
+``conf/icl_tasks_config/tasks_v0.3.yaml`` + ``eval_gauntlet_config/
+eval_gauntlet_v0.3.yaml`` (category-weighted, random-baseline-subtracted
+averages). TPU-first rebuild: tasks are jsonl files, scoring is a single
+jitted continuation-logprob function over fixed ``[B, S]`` batches (static
+shapes — XLA compiles once per task batch shape).
+
+Task rows (jsonl):
+- multiple choice: ``{"query": str, "choices": [str], "gold": int}``
+- language modeling: ``{"context": str, "continuation": str}``
+
+Scoring: log p(continuation | context) summed over continuation tokens; MC
+accuracy = argmax over per-choice logprob (length-normalized option too).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from typing import Any, Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class ICLTask:
+    name: str
+    kind: str  # "multiple_choice" | "language_modeling"
+    rows: list[dict]
+    category: str = "general"
+    random_baseline: float = 0.0
+
+    @classmethod
+    def from_jsonl(cls, path: str | pathlib.Path, name: str | None = None,
+                   category: str = "general") -> "ICLTask":
+        p = pathlib.Path(path)
+        rows = [json.loads(line) for line in p.read_text().splitlines() if line.strip()]
+        if not rows:
+            raise ValueError(f"empty task file {p}")
+        kind = "multiple_choice" if "choices" in rows[0] else "language_modeling"
+        baseline = 1.0 / len(rows[0]["choices"]) if kind == "multiple_choice" else 0.0
+        return cls(name or p.stem, kind, rows, category, baseline)
+
+
+def make_logprob_fn(model_apply: Callable, params: Any, seq_len: int) -> Callable:
+    """Jitted ``(tokens [B,S], mask [B,S]) -> per-row continuation logprob``.
+
+    ``mask`` is 1.0 on continuation positions (predicting token t from t-1).
+    """
+
+    @jax.jit
+    def logprob(tokens, mask):
+        logits = model_apply(params, tokens)  # [B, S, V]
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        tgt = tokens[:, 1:]
+        row = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]  # [B, S-1]
+        return jnp.sum(row * mask[:, 1:], axis=-1)
+
+    del seq_len
+    return logprob
+
+
+def _encode_pair(tokenizer, context: str, continuation: str, seq_len: int):
+    """→ (tokens [S], mask [S]) with right-side truncation of the context."""
+    ctx = tokenizer.encode(context)
+    cont = tokenizer.encode(continuation)
+    if not cont:
+        raise ValueError(f"continuation tokenizes to nothing: {continuation!r}")
+    room = seq_len - len(cont)
+    if room < 1:
+        cont = cont[: seq_len - 1]
+        room = seq_len - len(cont)
+    ctx = ctx[-room:]
+    toks = np.zeros(seq_len, np.int32)
+    mask = np.zeros(seq_len, np.float32)
+    n = len(ctx) + len(cont)
+    toks[:n] = ctx + cont
+    mask[len(ctx):n] = 1.0
+    return toks, mask
+
+
+def evaluate_task(
+    task: ICLTask,
+    tokenizer,
+    logprob_fn: Callable,
+    seq_len: int,
+    batch_size: int = 16,
+    length_normalize: bool = True,
+    max_rows: int | None = None,
+) -> dict[str, float]:
+    """Score one task; returns ``{accuracy | logprob_per_token, n_rows}``."""
+    rows = task.rows[:max_rows] if max_rows else task.rows
+
+    pending: list[tuple[np.ndarray, np.ndarray, float]] = []  # toks, mask, n_cont
+
+    def flush(buf):
+        toks = np.stack([t for t, _, _ in buf])
+        masks = np.stack([m for _, m, _ in buf])
+        pad = batch_size - len(buf)
+        if pad:
+            toks = np.concatenate([toks, np.zeros((pad, seq_len), np.int32)])
+            masks = np.concatenate([masks, np.zeros((pad, seq_len), np.float32)])
+        out = np.asarray(logprob_fn(toks, masks))[: len(buf)]
+        lens = np.asarray([n for _, _, n in buf])
+        return out / lens if length_normalize else out
+
+    if task.kind == "multiple_choice":
+        correct = 0
+        for row in rows:
+            scores = []
+            for choice in row["choices"]:
+                t, m, = _encode_pair(tokenizer, row["query"], choice, seq_len)[:2]
+                pending.append((t, m, max(float(m.sum()), 1.0)))
+            # score all choices of this row in one (padded) batch
+            if len(pending) > batch_size:
+                raise ValueError(f"{len(row['choices'])} choices > batch {batch_size}")
+            scores = flush(pending)
+            pending = []
+            if int(np.argmax(scores)) == int(row["gold"]):
+                correct += 1
+        acc = correct / len(rows)
+        return {"accuracy": acc, "n_rows": float(len(rows))}
+
+    # language modeling: mean per-token continuation logprob
+    total_lp, total_tok = 0.0, 0.0
+    buf: list[tuple[np.ndarray, np.ndarray, float]] = []
+    for row in rows:
+        t, m = _encode_pair(tokenizer, row["context"], row["continuation"], seq_len)
+        buf.append((t, m, max(float(m.sum()), 1.0)))
+        if len(buf) == batch_size:
+            lps = flush(buf)
+            total_lp += float(np.sum(lps * np.asarray([n for _, _, n in buf])))
+            total_tok += sum(n for _, _, n in buf)
+            buf = []
+    if buf:
+        lps = flush(buf)
+        total_lp += float(np.sum(lps * np.asarray([n for _, _, n in buf])))
+        total_tok += sum(n for _, _, n in buf)
+    return {"logprob_per_token": total_lp / max(total_tok, 1.0), "n_rows": float(len(rows))}
+
+
+def run_gauntlet(
+    tasks: Iterable[ICLTask],
+    tokenizer,
+    model_apply: Callable,
+    params: Any,
+    seq_len: int = 256,
+    batch_size: int = 16,
+    max_rows: int | None = None,
+) -> dict[str, float]:
+    """Evaluate all tasks; per-category averages subtract each task's random
+    baseline and rescale (reference gauntlet averaging:
+    ``eval_gauntlet_v0.3.yaml`` ``subtract_random_baseline/rescale``)."""
+    logprob_fn = make_logprob_fn(model_apply, params, seq_len)
+    out: dict[str, float] = {}
+    by_cat: dict[str, list[float]] = {}
+    for task in tasks:
+        res = evaluate_task(task, tokenizer, logprob_fn, seq_len, batch_size, max_rows=max_rows)
+        for k, v in res.items():
+            if k != "n_rows":
+                out[f"icl/{task.name}/{k}"] = v
+        if task.kind == "multiple_choice":
+            score = (res["accuracy"] - task.random_baseline) / max(1.0 - task.random_baseline, 1e-9)
+            by_cat.setdefault(task.category, []).append(max(score, 0.0))
+    for cat, scores in by_cat.items():
+        out[f"icl/category/{cat}"] = float(np.mean(scores))
+    if by_cat:
+        out["icl/average"] = float(np.mean([out[f"icl/category/{c}"] for c in by_cat]))
+    return out

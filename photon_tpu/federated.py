@@ -1,0 +1,104 @@
+"""Federated training entry point — the analog of the reference's launch
+pipeline (``scripts/photon_llm_125M.sh``: hydra_resolver → superlink →
+server-app → client-app). TPU-first there is no external broker: one command
+assembles the server driver, node agents, transport and checkpointing and
+runs the round loop.
+
+Examples::
+
+    # 8 synthetic clients, 3 rounds, tiny model, single process
+    python -m photon_tpu.federated --preset mpt-125m --rounds 3 \
+        --set model.n_layers=2 --set fl.local_steps=8
+
+    # node agents as separate processes over the objstore plane
+    python -m photon_tpu.federated --config run.yaml --nodes 2 --multiprocess
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+
+from photon_tpu.checkpoint import ClientCheckpointManager, FileStore, ServerCheckpointManager
+from photon_tpu.config import load_preset
+from photon_tpu.config.schema import Config
+from photon_tpu.federation import (
+    InProcessDriver,
+    MultiprocessDriver,
+    NodeAgent,
+    ParamTransport,
+    ServerApp,
+)
+from photon_tpu.metrics.history import make_wandb_run
+
+
+def build_app(cfg: Config, n_nodes: int = 1, multiprocess: bool = False) -> ServerApp:
+    save = pathlib.Path(cfg.photon.save_path)
+    save.mkdir(parents=True, exist_ok=True)
+    cfg.to_yaml(save / "config.yaml")  # the resolved config of record
+
+    store = FileStore(save / "store")
+    mode = "objstore" if (multiprocess or cfg.photon.comm_stack.objstore) else (
+        "shm" if cfg.photon.comm_stack.shm else "inline"
+    )
+
+    if multiprocess:
+        cfg.photon.comm_stack.objstore = True
+        cfg.photon.comm_stack.shm = False
+        driver = MultiprocessDriver(cfg, n_nodes=n_nodes)
+    else:
+        def make_agent(node_id: str) -> NodeAgent:
+            return NodeAgent(
+                cfg,
+                node_id,
+                make_transport=lambda: ParamTransport(mode, store=store),
+                make_ckpt_mgr=lambda: ClientCheckpointManager(store, cfg.run_uuid),
+            )
+
+        driver = InProcessDriver(cfg, make_agent, n_nodes=n_nodes)
+
+    transport = ParamTransport(mode, store=store)
+    ckpt = ServerCheckpointManager(store, cfg.run_uuid) if cfg.photon.checkpoint else None
+    from photon_tpu.metrics.history import History
+
+    history = History(make_wandb_run(None, cfg.run_uuid))
+    return ServerApp(cfg, driver, transport, ckpt_mgr=ckpt, history=history)
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description="photon-tpu federated training")
+    ap.add_argument("--config", help="resolved config YAML")
+    ap.add_argument("--preset", default=None, help="model preset (mpt-125m … mpt-7b)")
+    ap.add_argument("--rounds", type=int, default=None)
+    ap.add_argument("--nodes", type=int, default=1)
+    ap.add_argument("--multiprocess", action="store_true")
+    # action="append": each --set adds one override (nargs="*" would make
+    # every repeated --set silently REPLACE the previous list)
+    ap.add_argument("--set", action="append", default=[], metavar="KEY=VALUE")
+    args = ap.parse_args(argv)
+
+    if args.config:
+        cfg = Config.from_yaml(args.config)
+    elif args.preset:
+        cfg = load_preset(args.preset)
+    else:
+        cfg = Config()
+    from photon_tpu.centralized import _apply_override
+
+    for kv in args.set:
+        key, _, value = kv.partition("=")
+        _apply_override(cfg, key, value)
+    cfg.validate()
+
+    app = build_app(cfg, n_nodes=args.nodes, multiprocess=args.multiprocess)
+    try:
+        history = app.run(args.rounds)
+    finally:
+        app.driver.shutdown()
+    final = {k: history.latest(k) for k in ("server/round_time", "server/eval_loss", "server/pseudo_grad_norm")}
+    print(json.dumps({"rounds": args.rounds or cfg.fl.n_rounds, **{k: v for k, v in final.items() if v is not None}}))
+
+
+if __name__ == "__main__":
+    main()

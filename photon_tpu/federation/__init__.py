@@ -1,0 +1,41 @@
+"""Federation layer: server round loop, node agents, drivers, transports
+(reference: ``photon/server_app.py`` / ``photon/client_app.py`` /
+``photon/node_manager/`` / ``photon/worker/`` topology, rebuilt TPU-first —
+a client is a mesh slice, not a process gang)."""
+
+from photon_tpu.federation.client_runtime import ClientRuntime
+from photon_tpu.federation.driver import Driver, InProcessDriver, MultiprocessDriver
+from photon_tpu.federation.messages import (
+    Ack,
+    Broadcast,
+    ClientState,
+    EvaluateIns,
+    EvaluateRes,
+    FitIns,
+    FitRes,
+    ParamPointer,
+    Query,
+)
+from photon_tpu.federation.node import NodeAgent
+from photon_tpu.federation.server import ServerApp, TooManyFailuresError
+from photon_tpu.federation.transport import ParamTransport
+
+__all__ = [
+    "ClientRuntime",
+    "Driver",
+    "InProcessDriver",
+    "MultiprocessDriver",
+    "NodeAgent",
+    "ServerApp",
+    "TooManyFailuresError",
+    "ParamTransport",
+    "Ack",
+    "Broadcast",
+    "ClientState",
+    "EvaluateIns",
+    "EvaluateRes",
+    "FitIns",
+    "FitRes",
+    "ParamPointer",
+    "Query",
+]

@@ -1,0 +1,320 @@
+"""ClientRuntime: executes fit/eval tasks for client ids on this host's chips.
+
+Role parity with the reference's Worker + client train entry
+(``photon/worker/worker.py:209-293``, ``clients/llm_client_functions.py``):
+
+- ONE persistent :class:`Trainer` reused across rounds and cids — optimizer
+  state and jit caches survive (reference ``external_trainer`` reuse,
+  ``worker.py:207,254``). TPU-first: no per-GPU process gang; JAX owns every
+  chip of the host through one mesh.
+- Per-cid data loaders with resumable state (reference: per-client MDS
+  streams, ``llm_config_functions.py:388-436``; dataset state resets,
+  ``clients/utils.py:177-254``).
+- ``server_steps_cumulative`` is injected into the optimizer step counter so
+  lr schedule/bias correction continue mid-schedule (``clients/utils.py:332-341``).
+- Post-round: pseudo-gradient L2 norm telemetry and client-state bookkeeping
+  (``clients/utils.py:514-652``).
+- Optional client checkpoints with skip-if-done round resume
+  (``llm_config_functions.py:642-764``).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import time
+import zlib
+from typing import Any
+
+import numpy as np
+
+
+def _stable_seed(*parts) -> int:
+    """Deterministic across processes/runs (Python ``hash`` is salted per
+    process, which would desync spawned node agents)."""
+    return zlib.crc32("/".join(str(p) for p in parts).encode()) & 0x7FFFFFFF
+
+from photon_tpu.checkpoint.client import ClientCheckpointManager
+from photon_tpu.codec import ParamsMetadata
+from photon_tpu.config.schema import Config
+from photon_tpu.data import LoaderState, ShardedDataset, StreamingLoader, make_synthetic_dataset
+from photon_tpu.federation.messages import ClientState, EvaluateIns, EvaluateRes, FitIns, FitRes
+from photon_tpu.federation.transport import ParamTransport
+from photon_tpu.train.trainer import Trainer
+
+
+def _l2(arrays: list[np.ndarray]) -> float:
+    return float(np.sqrt(sum(float(np.sum(np.square(a, dtype=np.float64))) for a in arrays)))
+
+
+class ClientRuntime:
+    def __init__(
+        self,
+        cfg: Config,
+        transport: ParamTransport,
+        node_id: str = "node0",
+        ckpt_mgr: ClientCheckpointManager | None = None,
+    ) -> None:
+        self.cfg = cfg
+        self.transport = transport
+        self.node_id = node_id
+        self.ckpt_mgr = ckpt_mgr
+        self.trainer = Trainer(cfg)
+        self._loaders: dict[tuple[int, str], StreamingLoader] = {}
+        self._current_params: tuple[ParamsMetadata, list[np.ndarray]] | None = None
+        self._personal: dict[int, list[np.ndarray]] = {}  # per-cid personalized layers
+
+    # -- data ------------------------------------------------------------
+    def _loader(self, cid: int, split: str, batch_size: int) -> StreamingLoader:
+        key = (cid, split)
+        if key not in self._loaders:
+            ds_cfg = self.cfg.dataset
+            if ds_cfg.synthetic or not ds_cfg.local_path:
+                root = pathlib.Path(self.cfg.photon.save_path) / "synthetic" / f"client_{cid}" / split
+                if not (root / "index.json").exists():
+                    make_synthetic_dataset(
+                        str(root),
+                        n_samples=max(4 * batch_size, 64),
+                        seq_len=self.cfg.model.max_seq_len,
+                        vocab_size=self.cfg.model.vocab_size,
+                        seed=_stable_seed(cid, split),
+                    )
+                ds = ShardedDataset(root)
+            else:
+                # reference stream assignment: streams[cid % n] — here the
+                # conversion pipeline wrote client_{cid}/{split} directly
+                ds = ShardedDataset(pathlib.Path(ds_cfg.local_path) / f"client_{cid}" / split)
+            self._loaders[key] = StreamingLoader(
+                ds,
+                batch_size=batch_size,
+                seed=ds_cfg.shuffle_seed + cid,
+                shuffle=ds_cfg.shuffle and split == ds_cfg.split_train,
+            )
+        return self._loaders[key]
+
+    # -- params ----------------------------------------------------------
+    def set_broadcast_params(self, ptr) -> None:
+        """Cache the round's global params (reference: NM params shm write,
+        ``client_app.py:104-115``)."""
+        self._current_params = self.transport.get(ptr, copy=True)
+
+    def _resolve_params(self, ptr) -> tuple[ParamsMetadata, list[np.ndarray]]:
+        if ptr is not None:
+            self._current_params = self.transport.get(ptr, copy=True)
+        if self._current_params is None:
+            raise RuntimeError("no parameters: neither FitIns pointer nor prior broadcast")
+        return self._current_params
+
+    # -- fit -------------------------------------------------------------
+    def fit(self, ins: FitIns, cid: int) -> FitRes:
+        t_start = time.monotonic()
+        try:
+            return self._fit_inner(ins, cid, t_start)
+        except Exception as e:  # noqa: BLE001 — worker-level failure isolation
+            # reference: exception → error result so the node can retry the
+            # cid elsewhere (``worker.py:427-448``)
+            return FitRes(
+                server_round=ins.server_round, cid=cid, params=None,
+                error=f"{type(e).__name__}: {e}",
+            )
+
+    def _fit_inner(self, ins: FitIns, cid: int, t_start: float) -> FitRes:
+        cfg = self.cfg
+        state_in = ClientState.from_dict(ins.client_states[cid]) if cid in ins.client_states else ClientState(cid)
+        target_step = ins.server_steps_cumulative + ins.local_steps
+
+        # skip-if-done: post-round client checkpoint already exists
+        if (
+            self.ckpt_mgr is not None
+            and ins.config.get("client_checkpoints", False)
+            and self.ckpt_mgr.should_skip_round(cid, target_step)
+        ):
+            pm, pa, opt, extra = self.ckpt_mgr.load(cid, target_step)
+            return self._package_result(
+                ins, cid, state_in, pm, pa,
+                n_samples=ins.local_steps * cfg.train.global_batch_size,
+                metrics={"client/skipped_round": 1.0},
+                t_start=t_start,
+            )
+
+        meta, arrays = self._resolve_params(ins.params)
+        t_set0 = time.monotonic()
+
+        # momenta piggybacking: [params|m1|m2] payloads (reference
+        # ``manipulate_pre_training_ndarrays``, ``clients/utils.py:405-511``)
+        from photon_tpu.train.param_ops import (
+            extend_with_momenta,
+            has_momenta,
+            personalize_layers,
+            randomize_layers,
+            split_momenta,
+        )
+
+        carry_momenta = has_momenta(meta)
+        if carry_momenta:
+            base_meta, params_in, m1_in, m2_in = split_momenta(meta, arrays)
+        else:
+            base_meta, params_in, m1_in, m2_in = meta, list(arrays), None, None
+
+        if ins.config.get("personalize_patterns"):
+            params_in = personalize_layers(
+                base_meta, params_in, self._personal.get(cid), ins.config["personalize_patterns"]
+            )
+        if ins.config.get("randomize_patterns"):
+            params_in = randomize_layers(
+                base_meta, params_in, ins.config["randomize_patterns"],
+                seed=_stable_seed(cid, ins.server_round),
+            )
+
+        self.trainer.set_parameters(base_meta, params_in)
+        initial = [a.copy() for a in params_in]
+
+        # reset knobs (reference: ``load_ignore_keys`` globs, ``clients/utils.py:219-249``)
+        if ins.config.get("reset_optimizer", False):
+            self.trainer.reset_optimizer()
+        elif carry_momenta:
+            self.trainer.set_momenta(m1_in, m2_in)
+        self.trainer.set_step(ins.server_steps_cumulative)
+
+        fresh = (cid, cfg.dataset.split_train) not in self._loaders
+        loader = self._loader(cid, cfg.dataset.split_train, cfg.train.global_batch_size)
+        if ins.config.get("reset_dataset_state", False):
+            loader.load_state_dict(LoaderState().to_dict())
+        elif "loader_state" in ins.config:
+            loader.load_state_dict(ins.config["loader_state"][cid])
+        elif fresh and state_in.samples_cumulative > 0:
+            # node restart / server resume: a fresh loader fast-forwards to the
+            # client's cumulative sample position so the data order matches an
+            # uninterrupted run (reference: resumable streaming dataset state,
+            # ``clients/utils.py:177-254`` reset_dataset_state semantics)
+            loader.skip_samples(state_in.samples_cumulative)
+
+        fit_metrics = self.trainer.fit(
+            loader, ins.local_steps, log_every=cfg.train.log_interval
+        )
+        fit_metrics["client/fit_set_parameters_time"] = time.monotonic() - t_set0
+
+        out_meta, out_arrays = self.trainer.get_parameters()
+        n_samples = ins.local_steps * cfg.train.global_batch_size
+
+        # pseudo-gradient telemetry (reference: ``post_process_client_result``
+        # L2 norms, ``clients/utils.py:599-619``)
+        delta = [o - i for o, i in zip(out_arrays, initial)]
+        fit_metrics["client/pseudo_grad_norm"] = _l2(delta)
+        fit_metrics["client/param_norm"] = _l2(out_arrays)
+
+        if ins.config.get("personalize_patterns"):
+            self._personal[cid] = [a.copy() for a in out_arrays]
+        if carry_momenta:
+            m1_out, m2_out = self.trainer.get_momenta()
+            out_meta, out_arrays = extend_with_momenta(out_meta, out_arrays, m1_out, m2_out)
+
+        if self.ckpt_mgr is not None and ins.config.get("client_checkpoints", False):
+            om, oa = self.trainer.get_opt_state_arrays()
+            self.ckpt_mgr.save(
+                cid, target_step, out_meta, out_arrays, om, oa,
+                extra_state={"loader": loader.state_dict()},
+            )
+
+        return self._package_result(
+            ins, cid, state_in, out_meta, out_arrays, n_samples, fit_metrics, t_start
+        )
+
+    def _package_result(
+        self,
+        ins: FitIns,
+        cid: int,
+        state_in: ClientState,
+        meta: ParamsMetadata,
+        arrays: list[np.ndarray],
+        n_samples: int,
+        metrics: dict[str, float],
+        t_start: float,
+    ) -> FitRes:
+        wall = time.monotonic() - t_start
+        ptr = self.transport.put(f"fit-r{ins.server_round}-c{cid}-{self.node_id}", meta, arrays)
+        new_state = ClientState(
+            cid=cid,
+            steps_cumulative=state_in.steps_cumulative + ins.local_steps,
+            samples_cumulative=state_in.samples_cumulative + n_samples,
+            last_round=ins.server_round,
+            wall_time_s=state_in.wall_time_s + wall,
+        )
+        metrics = dict(metrics)
+        metrics["node_training_time_s"] = wall
+        return FitRes(
+            server_round=ins.server_round,
+            cid=cid,
+            params=ptr,
+            n_samples=n_samples,
+            metrics=metrics,
+            client_state=new_state.to_dict(),
+        )
+
+    # -- eval ------------------------------------------------------------
+    def evaluate(self, ins: EvaluateIns, cid: int) -> EvaluateRes:
+        try:
+            meta, arrays = self._resolve_params(ins.params)
+            from photon_tpu.train.param_ops import has_momenta, split_momenta
+
+            if has_momenta(meta):
+                meta, arrays, _, _ = split_momenta(meta, arrays)
+            self.trainer.set_parameters(meta, arrays)
+            cfg = self.cfg
+            loader = self._loader(cid, cfg.dataset.split_eval, cfg.train.global_batch_size)
+            n_batches = ins.max_batches or cfg.train.eval_batches
+            batches = [next(loader) for _ in range(n_batches)]
+            out = self.trainer.evaluate(batches)
+            out.update(self._unigram_metrics(cid, batches, out["eval/loss"]))
+            return EvaluateRes(
+                server_round=ins.server_round,
+                cid=cid,
+                loss=out["eval/loss"],
+                n_samples=int(out["eval/tokens"]),
+                metrics=out,
+            )
+        except Exception as e:  # noqa: BLE001
+            return EvaluateRes(
+                server_round=ins.server_round, cid=cid, error=f"{type(e).__name__}: {e}"
+            )
+
+    def _unigram_metrics(
+        self, cid: int, batches: list[np.ndarray], model_ce: float
+    ) -> dict[str, float]:
+        """Unigram-normalized eval metrics when the client's freq dict exists
+        (reference: unigram metric registration ``trainer_utils.py:278-327``,
+        freq-dict fetch/merge ``llm_config_functions.py:971-1109``)."""
+        from photon_tpu.data.unigram import FREQ_FILENAME, load_freq_dict
+        from photon_tpu.metrics.unigram import unigram_log_probs_from_counts
+
+        if not self.cfg.dataset.local_path:
+            return {}
+        freq_path = (
+            pathlib.Path(self.cfg.dataset.local_path)
+            / f"client_{cid}"
+            / self.cfg.dataset.split_train
+            / FREQ_FILENAME
+        )
+        if not freq_path.exists():
+            return {}
+        logp = unigram_log_probs_from_counts(
+            load_freq_dict(freq_path), self.cfg.model.vocab_size
+        )
+        tot, n = 0.0, 0
+        for b in batches:
+            targets = np.asarray(b)[:, 1:]
+            tot += float(-logp[targets].sum())
+            n += targets.size
+        uni_ce = tot / max(n, 1)
+        norm = model_ce - uni_ce
+        return {
+            "eval/PureUnigramCrossEntropy": uni_ce,
+            "eval/UnigramNormalizedLanguageCrossEntropy": norm,
+            "eval/UnigramNormalizedPerplexity": float(np.exp(np.clip(norm, -30.0, 30.0))),
+        }
+
+    # -- lifecycle -------------------------------------------------------
+    def loader_states(self) -> dict[str, Any]:
+        return {f"{cid}/{split}": ld.state_dict() for (cid, split), ld in self._loaders.items()}
+
+    def close(self) -> None:
+        self.transport.cleanup()

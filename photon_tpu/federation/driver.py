@@ -1,0 +1,193 @@
+"""Drivers: the control-plane link between the server and its nodes.
+
+Reference analog: the Flower Driver API (gRPC SuperLink,
+``server_util.py:144-202`` push/pull). Two implementations:
+
+- :class:`InProcessDriver` — nodes live in the server process (tests, and the
+  n_nodes=1 single-host fast path; the reference's closest analog is its
+  degraded all-roles-on-localhost mode, SURVEY.md §4).
+- :class:`MultiprocessDriver` — one OS process per node over ``mp.Pipe``
+  (reference: separate ``flower-client-app`` processes). Liveness is
+  monitored; a dead node yields synthesized error replies and is restarted
+  (reference: ``node_manager_app.py:326-351``).
+
+Both expose the same async-ish interface: ``send`` returns a message id,
+``recv_any`` returns the next completed reply from any node — exactly what
+the sliding-window round scheduler needs (``server_util.py:65-202``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import time
+from multiprocessing.connection import wait as mp_wait
+from typing import Any, Callable
+
+from photon_tpu.config.schema import Config
+from photon_tpu.federation.messages import Ack, Envelope, Query
+from photon_tpu.federation.node import NodeAgent, node_process_main
+
+
+class Driver:
+    def node_ids(self) -> list[str]:
+        raise NotImplementedError
+
+    def send(self, node_id: str, msg: Any) -> int:
+        raise NotImplementedError
+
+    def recv_any(self, timeout: float | None = None) -> tuple[str, int, Any]:
+        """→ (node_id, msg_id, reply). Raises TimeoutError."""
+        raise NotImplementedError
+
+    def broadcast(self, msg: Any, timeout: float = 300.0) -> dict[str, Ack]:
+        """Fan out one message to every node, wait for all acks (reference:
+        ``broadcast_utils.py:169-188``)."""
+        pending = {self.send(nid, msg): nid for nid in self.node_ids()}
+        acks: dict[str, Ack] = {}
+        deadline = time.monotonic() + timeout
+        while pending:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError(f"broadcast: no ack from {sorted(pending.values())}")
+            nid, mid, reply = self.recv_any(timeout=left)
+            if mid in pending:
+                del pending[mid]
+                acks[nid] = reply if isinstance(reply, Ack) else Ack(ok=True, node_id=nid)
+        return acks
+
+    def shutdown(self) -> None:
+        raise NotImplementedError
+
+
+class InProcessDriver(Driver):
+    def __init__(self, cfg: Config, make_agent: Callable[[str], NodeAgent], n_nodes: int = 1) -> None:
+        self._agents = {f"node{i}": make_agent(f"node{i}") for i in range(n_nodes)}
+        self._mid = itertools.count()
+        self._replies: list[tuple[str, int, Any]] = []
+        del cfg
+
+    def node_ids(self) -> list[str]:
+        return sorted(self._agents)
+
+    def send(self, node_id: str, msg: Any) -> int:
+        mid = next(self._mid)
+        reply = self._agents[node_id].handle(msg)
+        self._replies.append((node_id, mid, reply))
+        return mid
+
+    def recv_any(self, timeout: float | None = None) -> tuple[str, int, Any]:
+        if not self._replies:
+            raise TimeoutError("no pending replies")
+        return self._replies.pop(0)
+
+    def shutdown(self) -> None:
+        for agent in self._agents.values():
+            agent.runtime.close()
+
+
+class MultiprocessDriver(Driver):
+    def __init__(
+        self,
+        cfg: Config,
+        n_nodes: int,
+        platform: str | None = None,
+        n_cpu_devices: int = 1,
+        restart_dead: bool = True,
+    ) -> None:
+        self.cfg = cfg
+        self.platform = platform
+        self.n_cpu_devices = n_cpu_devices
+        self.restart_dead = restart_dead
+        self._mid = itertools.count()
+        self._ctx = mp.get_context("spawn")  # fresh JAX in children
+        self._nodes: dict[str, tuple[Any, Any]] = {}  # node_id -> (process, conn)
+        self._inflight: dict[str, list[int]] = {}
+        for i in range(n_nodes):
+            self._start(f"node{i}")
+
+    def _start(self, node_id: str) -> None:
+        parent, child = self._ctx.Pipe()
+        proc = self._ctx.Process(
+            target=node_process_main,
+            args=(self.cfg.to_json(), node_id, child, self.platform, self.n_cpu_devices),
+            daemon=True,
+            name=f"photon-{node_id}",
+        )
+        proc.start()
+        child.close()
+        self._nodes[node_id] = (proc, parent)
+        self._inflight[node_id] = []
+
+    def node_ids(self) -> list[str]:
+        return sorted(self._nodes)
+
+    def send(self, node_id: str, msg: Any) -> int:
+        mid = next(self._mid)
+        proc, conn = self._nodes[node_id]
+        conn.send(Envelope(msg, mid))
+        self._inflight[node_id].append(mid)
+        return mid
+
+    def recv_any(self, timeout: float | None = None) -> tuple[str, int, Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            conns = {conn: nid for nid, (proc, conn) in self._nodes.items() if self._inflight[nid]}
+            if not conns:
+                raise TimeoutError("recv_any: nothing in flight")
+            left = None if deadline is None else max(0.0, deadline - time.monotonic())
+            ready = mp_wait(list(conns), timeout=left)
+            if not ready:
+                raise TimeoutError("recv_any: timeout")
+            for conn in ready:
+                nid = conns[conn]
+                try:
+                    env: Envelope = conn.recv()
+                except (EOFError, OSError):
+                    # dead node: synthesize error replies for everything in
+                    # flight there, then restart it (reference:
+                    # ``node_manager_app.py:326-351`` dead-worker handling)
+                    mids = self._inflight[nid]
+                    self._inflight[nid] = []
+                    self._respawn(nid)
+                    if mids:
+                        return (
+                            nid,
+                            mids[0],
+                            Ack(ok=False, detail="node died", node_id=nid),
+                        )
+                    continue
+                self._inflight[nid].remove(env.msg_id)
+                return nid, env.msg_id, env.msg
+
+    def _respawn(self, node_id: str) -> None:
+        proc, conn = self._nodes[node_id]
+        try:
+            conn.close()
+        except OSError:
+            pass
+        if proc.is_alive():
+            proc.terminate()
+        proc.join(timeout=10)
+        if self.restart_dead:
+            self._start(node_id)
+        else:
+            del self._nodes[node_id]
+            del self._inflight[node_id]
+
+    def shutdown(self) -> None:
+        for nid, (proc, conn) in list(self._nodes.items()):
+            try:
+                conn.send(Envelope(Query("shutdown"), next(self._mid)))
+            except (OSError, BrokenPipeError):
+                pass
+        for nid, (proc, conn) in list(self._nodes.items()):
+            proc.join(timeout=15)
+            if proc.is_alive():
+                proc.terminate()
+                proc.join(timeout=5)
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._nodes.clear()

@@ -1,0 +1,127 @@
+"""Federation message schema.
+
+Reference contract (``photon/server/server_util.py:205-301``): control-plane
+messages carry round metadata and *pointer records* to bulk tensors — never
+the tensors themselves (``Parameters(tensors=[])`` + a transport record,
+SURVEY.md "big architectural idea"). Same here: a :class:`ParamPointer` names
+a shm segment or object-store key; the transport plane resolves it.
+
+Messages are plain dataclasses, serialized with pickle over trusted
+transports (mp pipes / localhost TCP between our own processes — the same
+trust model as the reference's Flower RecordSets, which are pickled configs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class ParamPointer:
+    """Where the bulk tensors live (reference: the remote record written by
+    ``replace_remote_with_parameters_in_recordset``, ``s3_utils.py:730-933``)."""
+
+    kind: str  # "shm" | "objstore" | "inline"
+    locator: str  # shm segment name or store key ("" for inline)
+    metadata_json: str  # ParamsMetadata.to_json()
+    inline: list | None = None  # only for kind="inline" (tests / tiny models)
+
+
+@dataclass
+class ClientState:
+    """Per-cid cumulative progress, merged server-side each round
+    (reference: ``ClientState`` dataclass, ``photon/utils.py:41-53``)."""
+
+    cid: int
+    steps_cumulative: int = 0
+    samples_cumulative: int = 0
+    last_round: int = -1
+    wall_time_s: float = 0.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ClientState":
+        return cls(**d)
+
+
+@dataclass
+class FitIns:
+    """Server → node: train these cids this round (reference FitIns recordset
+    fields, ``server_util.py:265-301``)."""
+
+    server_round: int
+    cids: list[int]
+    params: ParamPointer | None  # None = use last broadcast
+    local_steps: int
+    server_steps_cumulative: int
+    client_states: dict[int, dict] = field(default_factory=dict)
+    config: dict[str, Any] = field(default_factory=dict)  # reset knobs etc.
+
+
+@dataclass
+class FitRes:
+    server_round: int
+    cid: int
+    params: ParamPointer | None
+    n_samples: int = 0
+    metrics: dict[str, float] = field(default_factory=dict)
+    client_state: dict | None = None
+    error: str | None = None  # non-None = failure (reference WorkerResultMessage(-1))
+
+
+@dataclass
+class EvaluateIns:
+    server_round: int
+    cids: list[int]
+    params: ParamPointer | None
+    max_batches: int = 0
+    config: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class EvaluateRes:
+    server_round: int
+    cid: int
+    loss: float = 0.0
+    n_samples: int = 0
+    metrics: dict[str, float] = field(default_factory=dict)
+    error: str | None = None
+
+
+@dataclass
+class Broadcast:
+    """Server → all nodes: new global params (reference: query type
+    ``broadcast_parameters``, ``broadcast_utils.py:28-57``)."""
+
+    server_round: int
+    params: ParamPointer
+
+
+@dataclass
+class Ack:
+    ok: bool = True
+    detail: str = ""
+    node_id: str = ""
+
+
+@dataclass
+class Query:
+    """Generic control query (reference query dispatch ``client_app.py:285-291``):
+    ``free_resources`` | ``ping`` | ``shutdown`` | ``refresh``."""
+
+    action: str
+    payload: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Envelope:
+    """Transport wrapper with correlation id + timing (the Message analog)."""
+
+    msg: Any
+    msg_id: int
+    sent_at: float = field(default_factory=time.time)

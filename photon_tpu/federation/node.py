@@ -1,0 +1,137 @@
+"""NodeAgent: per-host agent executing federation tasks on a ClientRuntime.
+
+Role parity with the reference's NodeManagerApp + ClientApp handlers
+(``photon/node_manager/node_manager_app.py``, ``photon/client_app.py``), with
+the worker-process gang deleted: JAX already owns every chip of the host via
+one mesh, so the node IS the training executor (SURVEY.md §7 design stance).
+
+The agent serves a request loop over a duplex connection (mp.Pipe or a
+socket): FitIns / EvaluateIns / Broadcast / Query envelopes in, result
+envelopes out. ``Query("refresh")`` rebuilds the runtime — the analog of the
+reference's periodic worker restart (``client_app.py:175-177``).
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Callable
+
+from photon_tpu.config.schema import Config
+from photon_tpu.federation.client_runtime import ClientRuntime
+from photon_tpu.federation.messages import (
+    Ack,
+    Broadcast,
+    Envelope,
+    EvaluateIns,
+    FitIns,
+    Query,
+)
+from photon_tpu.federation.transport import ParamTransport
+
+
+class NodeAgent:
+    def __init__(
+        self,
+        cfg: Config,
+        node_id: str,
+        make_transport: Callable[[], ParamTransport],
+        make_ckpt_mgr: Callable[[], Any] | None = None,
+    ) -> None:
+        self.cfg = cfg
+        self.node_id = node_id
+        self._make_transport = make_transport
+        self._make_ckpt_mgr = make_ckpt_mgr
+        self.runtime = self._build_runtime()
+
+    def _build_runtime(self) -> ClientRuntime:
+        return ClientRuntime(
+            self.cfg,
+            self._make_transport(),
+            node_id=self.node_id,
+            ckpt_mgr=self._make_ckpt_mgr() if self._make_ckpt_mgr else None,
+        )
+
+    # -- dispatch --------------------------------------------------------
+    def handle(self, msg: Any) -> Any:
+        if isinstance(msg, FitIns):
+            return [self.runtime.fit(msg, cid) for cid in msg.cids]
+        if isinstance(msg, EvaluateIns):
+            return [self.runtime.evaluate(msg, cid) for cid in msg.cids]
+        if isinstance(msg, Broadcast):
+            try:
+                self.runtime.set_broadcast_params(msg.params)
+                return Ack(ok=True, node_id=self.node_id)
+            except Exception as e:  # noqa: BLE001
+                return Ack(ok=False, detail=f"{type(e).__name__}: {e}", node_id=self.node_id)
+        if isinstance(msg, Query):
+            return self._query(msg)
+        return Ack(ok=False, detail=f"unknown message {type(msg).__name__}", node_id=self.node_id)
+
+    def _query(self, q: Query) -> Ack:
+        if q.action == "ping":
+            return Ack(ok=True, node_id=self.node_id)
+        if q.action == "refresh":
+            # worker-refresh analog: drop runtime (jit caches, loaders), rebuild
+            states = self.runtime.loader_states()
+            self.runtime.close()
+            self.runtime = self._build_runtime()
+            del states  # loaders rebuild from FitIns-provided state
+            return Ack(ok=True, node_id=self.node_id)
+        if q.action == "free_resources":
+            self.runtime.transport.cleanup()
+            return Ack(ok=True, node_id=self.node_id)
+        if q.action == "shutdown":
+            self.runtime.close()
+            return Ack(ok=True, detail="bye", node_id=self.node_id)
+        return Ack(ok=False, detail=f"unknown query {q.action!r}", node_id=self.node_id)
+
+    # -- serving loop (child process entry) ------------------------------
+    def serve(self, conn) -> None:
+        """Blocking loop over a Connection-like object with send/recv."""
+        while True:
+            try:
+                env: Envelope = conn.recv()
+            except EOFError:
+                break
+            try:
+                reply = self.handle(env.msg)
+            except Exception as e:  # noqa: BLE001 — never kill the loop silently
+                reply = Ack(
+                    ok=False,
+                    detail=f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
+                    node_id=self.node_id,
+                )
+            conn.send(Envelope(reply, env.msg_id))
+            if isinstance(env.msg, Query) and env.msg.action == "shutdown":
+                break
+
+
+def node_process_main(cfg_json: str, node_id: str, conn, platform: str | None, n_cpu_devices: int) -> None:
+    """Entry point for a spawned node process (reference:
+    ``flower-client-app`` process). Platform is pinned before first backend
+    use — tests force CPU with N virtual devices."""
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+        if platform == "cpu" and n_cpu_devices > 1:
+            jax.config.update("jax_num_cpu_devices", n_cpu_devices)
+
+    cfg = Config.from_json(cfg_json)
+    store = None
+    if cfg.photon.comm_stack.objstore or cfg.photon.checkpoint:
+        from photon_tpu.checkpoint.store import FileStore
+
+        store = FileStore(cfg.photon.save_path + "/store")
+
+    def make_transport() -> ParamTransport:
+        mode = "objstore" if cfg.photon.comm_stack.objstore else "shm"
+        return ParamTransport(mode, store=store)
+
+    def make_ckpt():
+        from photon_tpu.checkpoint.client import ClientCheckpointManager
+
+        return ClientCheckpointManager(store, cfg.run_uuid) if store else None
+
+    agent = NodeAgent(cfg, node_id, make_transport, make_ckpt)
+    agent.serve(conn)

@@ -1,0 +1,356 @@
+"""ServerApp: the federated round loop.
+
+Role parity with ``photon/server_app.py`` + ``photon/server/fit_utils.py`` /
+``evaluate_utils.py`` / ``server_util.py``:
+
+- deterministic client sampling via ``random.Random(sample_seed)``, with
+  PRNG fast-forward on resume so the sampled sequence is identical to an
+  uninterrupted run (``server_app.py:124,187-193,295``);
+- sliding-window scheduling: one outstanding cid per node, refilled as
+  replies arrive, replies consumed as a generator (``server_util.py:65-202``);
+- streaming aggregation: client tensors are fetched, folded into the running
+  average, and freed one at a time (``fit_utils.py:92-217``);
+- failure budget: failed cids are retried once on another node; more than
+  ``accept_failures_cnt`` failures raises :class:`TooManyFailuresError`
+  unless ``ignore_failed_rounds`` (``fit_utils.py:198-210,257-288``);
+- round checkpoints + resume (negative indexing) + GC; client-state merge and
+  ``server_steps_cumulative`` bookkeeping; round-time KPI metrics under the
+  reference's names (BASELINE.md KPI table).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+import uuid as uuid_mod
+from collections import deque
+from typing import Callable, Iterator
+
+import numpy as np
+
+from photon_tpu.checkpoint.server import ServerCheckpointManager
+from photon_tpu.codec import ParamsMetadata
+from photon_tpu.config.schema import Config
+from photon_tpu.federation.driver import Driver
+from photon_tpu.federation.messages import (
+    Ack,
+    Broadcast,
+    EvaluateIns,
+    EvaluateRes,
+    FitIns,
+    FitRes,
+)
+from photon_tpu.federation.transport import ParamTransport
+from photon_tpu.metrics.history import History
+from photon_tpu.strategy import dispatch_strategy
+from photon_tpu.strategy.base import ClientResult
+from photon_tpu.strategy.metrics import GradientNoiseScale
+
+
+class TooManyFailuresError(RuntimeError):
+    """Round failure budget exceeded (reference: ``server_util.py:31``)."""
+
+
+class ServerApp:
+    def __init__(
+        self,
+        cfg: Config,
+        driver: Driver,
+        transport: ParamTransport,
+        ckpt_mgr: ServerCheckpointManager | None = None,
+        history: History | None = None,
+        initial_params: tuple[ParamsMetadata, list[np.ndarray]] | None = None,
+    ) -> None:
+        self.cfg = cfg
+        self.driver = driver
+        self.transport = transport
+        self.ckpt_mgr = ckpt_mgr
+        self.history = history or History()
+        self.strategy = dispatch_strategy(cfg.fl)
+        self.gns = GradientNoiseScale()
+        self.server_steps_cumulative = 0
+        self.client_states: dict[int, dict] = {}
+        self.start_round = 1
+        self._rng = random.Random(cfg.fl.sample_seed)
+        self._rounds_sampled = 0
+        self._last_broadcast: Broadcast | None = None
+
+        if initial_params is None:
+            from photon_tpu.models.mpt import init_params
+            from photon_tpu.codec import params_to_ndarrays
+
+            initial_params = params_to_ndarrays(init_params(cfg.model, seed=cfg.seed))
+        self.metadata, params = initial_params
+        if cfg.fl.aggregate_momenta:
+            # payloads become [params|m1|m2]; the strategies aggregate the
+            # momenta sections with the same weighted average (reference:
+            # zero momenta appended at init, ``clients/utils.py:739-868``)
+            from photon_tpu.train.param_ops import extend_with_momenta, has_momenta
+
+            if not has_momenta(self.metadata):
+                self.metadata, params = extend_with_momenta(self.metadata, params)
+        self.strategy.initialize(params)
+
+    # ------------------------------------------------------------------
+    # resume / checkpoint
+    # ------------------------------------------------------------------
+    def try_resume(self) -> int | None:
+        """Restore from ``cfg.photon.resume_round`` if set; returns the
+        restored round (reference: ``init_utils.py:226``, ``s3_utils.py:551-727``)."""
+        if self.ckpt_mgr is None or self.cfg.photon.resume_round is None:
+            return None
+        keys = self.strategy.state_keys
+        rnd = self.ckpt_mgr.resolve_resume_round(self.cfg.photon.resume_round, keys)
+        metadata, params, strategy_state, server_state = self.ckpt_mgr.load_round(rnd, keys)
+        self.metadata = metadata
+        self.strategy.initialize(params, strategy_state)
+        self.server_steps_cumulative = int(server_state.get("server_steps_cumulative", 0))
+        self.client_states = {int(k): v for k, v in server_state.get("client_states", {}).items()}
+        self.history = History.from_dict(server_state.get("history", {}), self.history._wandb)
+        if "gns" in server_state:
+            self.gns.load_state_dict(server_state["gns"])
+        # PRNG fast-forward keeps the client-sample sequence identical
+        # (reference: ``server_app.py:187-193``)
+        consumed = int(server_state.get("rounds_sampled", rnd))
+        for _ in range(consumed):
+            self._sample_clients()
+        self.start_round = rnd + 1
+        return rnd
+
+    def save_checkpoint(self, server_round: int) -> None:
+        if self.ckpt_mgr is None:
+            return
+        assert self.strategy.current_parameters is not None
+        self.ckpt_mgr.save_round(
+            server_round,
+            self.metadata,
+            self.strategy.current_parameters,
+            self.strategy.state_for_checkpoint(),
+            {
+                "server_steps_cumulative": self.server_steps_cumulative,
+                "client_states": self.client_states,
+                "history": self.history.to_dict(),
+                "rounds_sampled": self._rounds_sampled,
+                "gns": self.gns.state_dict(),
+                "run_uuid": self.cfg.run_uuid,
+                "saved_at": time.time(),
+            },
+        )
+        self.ckpt_mgr.cleanup(self.cfg.photon.keep_checkpoints, self.strategy.state_keys)
+
+    # ------------------------------------------------------------------
+    # round mechanics
+    # ------------------------------------------------------------------
+    def _sample_clients(self) -> list[int]:
+        """Sample ``n_clients_per_round`` of ``n_total_clients`` (reference:
+        ``random.Random(seed).sample``, ``server_app.py:295``)."""
+        self._rounds_sampled += 1
+        return sorted(
+            self._rng.sample(range(self.cfg.fl.n_total_clients), self.cfg.fl.n_clients_per_round)
+        )
+
+    def broadcast_parameters(self, server_round: int) -> float:
+        """Push current global params to every node; returns elapsed seconds
+        (reference: ``broadcast_parameters_to_nodes``, ``broadcast_utils.py:60-201``)."""
+        t0 = time.monotonic()
+        assert self.strategy.current_parameters is not None
+        ptr = self.transport.put(
+            f"bcast-r{server_round}-{uuid_mod.uuid4().hex[:8]}",
+            self.metadata,
+            self.strategy.current_parameters,
+        )
+        msg = Broadcast(server_round, ptr)
+        acks = self.driver.broadcast(msg)
+        bad = [nid for nid, a in acks.items() if not a.ok]
+        if bad:
+            raise RuntimeError(f"broadcast failed on nodes {bad}: {[acks[n].detail for n in bad]}")
+        # free the PREVIOUS round's segment only now: nodes have copied the
+        # new payload (ack'd), nothing references the old one (reference:
+        # Ray GC thread / per-round shm unlink, ``utils.py:73-144``)
+        if self._last_broadcast is not None:
+            self.transport.free(self._last_broadcast.params)
+        self._last_broadcast = msg
+        return time.monotonic() - t0
+
+    def free_transport(self) -> None:
+        """Release the live broadcast segment + any transport leftovers; call
+        when the round loop ends."""
+        if self._last_broadcast is not None:
+            self.transport.free(self._last_broadcast.params)
+            self._last_broadcast = None
+        self.transport.cleanup()
+
+    def _sliding_window(
+        self,
+        server_round: int,
+        cids: list[int],
+        make_ins: Callable[[list[int]], object],
+        timeout: float,
+    ) -> Iterator[object]:
+        """One outstanding cid per node; failed cids retried once elsewhere
+        (reference: ``message_collaborative`` + node-side requeue)."""
+        queue: deque[int] = deque(cids)
+        retried: set[int] = set()
+        inflight: dict[int, tuple[str, int]] = {}
+        free: deque[str] = deque(self.driver.node_ids())
+        failures: list[tuple[int, str]] = []
+
+        while queue or inflight:
+            while queue and free:
+                nid, cid = free.popleft(), queue.popleft()
+                mid = self.driver.send(nid, make_ins([cid]))
+                inflight[mid] = (nid, cid)
+            nid, mid, reply = self.driver.recv_any(timeout=timeout)
+            if mid not in inflight:
+                continue
+            _, cid = inflight.pop(mid)
+            free.append(nid)
+            replies = reply if isinstance(reply, list) else [reply]
+            for res in replies:
+                err = res.detail if isinstance(res, Ack) else getattr(res, "error", None)
+                if isinstance(res, Ack) or err:
+                    if isinstance(res, Ack) and "node died" in (res.detail or "") and self._last_broadcast is not None:
+                        # the respawned node has no round params — re-send the
+                        # broadcast before any retry lands there (its ack is
+                        # drained by the `mid not in inflight` guard above)
+                        self.driver.send(nid, self._last_broadcast)
+                    if cid not in retried and len(self.driver.node_ids()) > 0:
+                        retried.add(cid)
+                        queue.append(cid)
+                    else:
+                        failures.append((cid, err or "unknown"))
+                    continue
+                yield res
+
+        if failures:
+            if len(failures) > self.cfg.fl.accept_failures_cnt:
+                raise TooManyFailuresError(
+                    f"round {server_round}: {len(failures)} failures "
+                    f"(budget {self.cfg.fl.accept_failures_cnt}): {failures}"
+                )
+
+    # ------------------------------------------------------------------
+    # rounds
+    # ------------------------------------------------------------------
+    def fit_round(self, server_round: int) -> dict[str, float]:
+        t_round = time.monotonic()
+        cids = self._sample_clients()
+        local_steps = self.cfg.fl.local_steps
+
+        def make_ins(cid_batch: list[int]) -> FitIns:
+            return FitIns(
+                server_round=server_round,
+                cids=cid_batch,
+                params=None,  # nodes use the round's broadcast
+                local_steps=local_steps,
+                server_steps_cumulative=self.server_steps_cumulative,
+                client_states={c: self.client_states[c] for c in cid_batch if c in self.client_states},
+                config=dict(self.cfg.fl.fit_config),
+            )
+
+        per_client_sq: list[float] = []
+        per_client_n: list[int] = []
+
+        def results() -> Iterator[ClientResult]:
+            for res in self._sliding_window(server_round, cids, make_ins, timeout=3600.0):
+                assert isinstance(res, FitRes)
+                _, arrays = self.transport.get(res.params)
+                if res.client_state:
+                    self.client_states[res.cid] = res.client_state
+                g = res.metrics.get("client/pseudo_grad_norm")
+                if g is not None:
+                    per_client_sq.append(float(g) ** 2)
+                    per_client_n.append(res.n_samples)
+                yield ClientResult(res.cid, arrays, res.n_samples, res.metrics)
+                self.transport.free(res.params)
+
+        t_fit = time.monotonic()
+        new_params, metrics = self.strategy.aggregate_fit(server_round, results())
+        metrics["server/fit_round_time"] = time.monotonic() - t_fit
+        del new_params  # strategy.current_parameters already updated
+
+        agg_sq = metrics.get("server/pseudo_grad_norm", 0.0) ** 2
+        metrics.update(self.gns.update(per_client_sq, per_client_n, agg_sq, sum(per_client_n)))
+
+        self.server_steps_cumulative += local_steps
+        metrics["server/steps_cumulative"] = float(self.server_steps_cumulative)
+        metrics["server/round_time"] = time.monotonic() - t_round
+        return metrics
+
+    def evaluate_round(self, server_round: int) -> dict[str, float]:
+        """Federated eval over all clients (reference: ``evaluate_round``,
+        ``evaluate_utils.py:232``; evaluates every client, not a sample)."""
+        cids = list(range(self.cfg.fl.n_total_clients))
+
+        def make_ins(cid_batch: list[int]) -> EvaluateIns:
+            return EvaluateIns(
+                server_round=server_round,
+                cids=cid_batch,
+                params=None,
+                max_batches=self.cfg.train.eval_batches,
+            )
+
+        results = []
+        for res in self._sliding_window(server_round, cids, make_ins, timeout=3600.0):
+            assert isinstance(res, EvaluateRes)
+            results.append((res.n_samples, res.loss, res.metrics))
+        loss, metrics = self.strategy.aggregate_evaluate(server_round, results)
+        return metrics
+
+    # ------------------------------------------------------------------
+    def run(self, n_rounds: int | None = None) -> History:
+        """The full driver loop (reference: ``server_app.main`` round loop,
+        ``server_app.py:279-405``)."""
+        cfg = self.cfg
+        n_rounds = n_rounds if n_rounds is not None else cfg.fl.n_rounds
+        resumed = self.try_resume()
+        if resumed is None and self.ckpt_mgr is not None and cfg.photon.restore_run_uuid:
+            self.ckpt_mgr.import_run(cfg.photon.restore_run_uuid, self.strategy.state_keys)
+            self.cfg.photon.resume_round = -1
+            resumed = self.try_resume()
+        if resumed is None and self.ckpt_mgr is not None and cfg.photon.checkpoint:
+            self.save_checkpoint(0)  # round-0 checkpoint (reference: initialize_round)
+
+        if cfg.fl.eval_interval_rounds and self.start_round == 1:
+            t_pre = self.broadcast_parameters(0)
+            m = self.evaluate_round(0)
+            m["server/broadcast_pre_time"] = t_pre
+            self.history.record(0, m)
+
+        try:
+            self._round_loop(cfg, n_rounds)
+        finally:
+            self.free_transport()
+        return self.history
+
+    def _round_loop(self, cfg: Config, n_rounds: int) -> None:
+        for rnd in range(self.start_round, n_rounds + 1):
+            if cfg.photon.refresh_period and rnd > 1 and (rnd - 1) % cfg.photon.refresh_period == 0:
+                from photon_tpu.federation.messages import Query
+
+                self.driver.broadcast(Query("refresh"))
+            t_pre = self.broadcast_parameters(rnd)
+            try:
+                metrics = self.fit_round(rnd)
+            except TooManyFailuresError:
+                if not cfg.fl.ignore_failed_rounds:
+                    raise
+                self.history.record(rnd, {"server/round_failed": 1.0})
+                continue
+            metrics["server/broadcast_pre_time"] = t_pre
+
+            if cfg.fl.eval_interval_rounds and rnd % cfg.fl.eval_interval_rounds == 0:
+                t_post = self.broadcast_parameters(rnd)
+                metrics.update(self.evaluate_round(rnd))
+                metrics["server/broadcast_post_time"] = t_post
+
+            if (
+                self.ckpt_mgr is not None
+                and cfg.photon.checkpoint
+                and rnd % cfg.photon.checkpoint_interval == 0
+            ):
+                t_ck = time.monotonic()
+                self.save_checkpoint(rnd)
+                metrics["server/checkpoint_time"] = time.monotonic() - t_ck
+
+            self.history.record(rnd, metrics)

@@ -1,0 +1,96 @@
+"""Bulk-tensor transport plane: resolve :class:`ParamPointer`s.
+
+Reference three-way (``photon/server/s3_utils.py:730-1115``): shm (single
+host, zero-copy), S3 (durable, cross-host), Ray object store (cross-process).
+Here:
+
+- ``shm``      — named tmpfs segments (``photon_tpu/shm``), single host;
+- ``objstore`` — the checkpoint object store (file/NFS/mounted bucket);
+- ``inline``   — tensors inside the message (tests, tiny models only).
+
+A fourth, TPU-native path — aggregation as a cross-slice collective over
+DCN — lives in ``photon_tpu/parallel/collective_agg.py`` and bypasses
+pointers entirely (SURVEY.md §7 stage 6 "marquee feature").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from photon_tpu.checkpoint.store import ObjectStore
+from photon_tpu.checkpoint.serialization import arrays_to_npz, npz_to_arrays
+from photon_tpu.codec import ParamsMetadata
+from photon_tpu.federation.messages import ParamPointer
+from photon_tpu.shm import plane as shm
+
+
+class ParamTransport:
+    """Writer/reader of parameter payloads behind pointers.
+
+    ``mode`` selects the plane (reference: ``photon.comm_stack{s3,shm,ray}``
+    config, ``base_schema.py:11-28``).
+    """
+
+    def __init__(self, mode: str = "shm", store: ObjectStore | None = None) -> None:
+        if mode not in ("shm", "objstore", "inline"):
+            raise ValueError(f"unknown transport mode {mode!r}")
+        if mode == "objstore" and store is None:
+            raise ValueError("objstore transport needs a store")
+        self.mode = mode
+        self.store = store
+        self._owned: list[str] = []  # shm segments we created (for cleanup)
+
+    # -- write -----------------------------------------------------------
+    def put(
+        self, tag: str, metadata: ParamsMetadata, arrays: list[np.ndarray]
+    ) -> ParamPointer:
+        if self.mode == "shm":
+            shm.write_params(tag, metadata, arrays)
+            self._owned.append(tag)
+            return ParamPointer("shm", tag, metadata.to_json())
+        if self.mode == "objstore":
+            assert self.store is not None
+            key = f"transport/{tag}.npz"
+            self.store.put(key, arrays_to_npz(metadata, arrays))
+            self._owned.append(key)
+            return ParamPointer("objstore", key, metadata.to_json())
+        return ParamPointer("inline", "", metadata.to_json(), inline=[np.asarray(a) for a in arrays])
+
+    # -- read ------------------------------------------------------------
+    def get(
+        self, ptr: ParamPointer, copy: bool = True, timeout: float = 120.0
+    ) -> tuple[ParamsMetadata, list[np.ndarray]]:
+        metadata = ParamsMetadata.from_json(ptr.metadata_json)
+        if ptr.kind == "shm":
+            shm.wait_for(ptr.locator, timeout=timeout)
+            got_meta, arrays = shm.read_params(ptr.locator, copy=copy)
+            metadata.validate_arrays(arrays)
+            return got_meta, arrays
+        if ptr.kind == "objstore":
+            assert self.store is not None, "objstore pointer but transport has no store"
+            self.store.wait_for(ptr.locator, timeout=timeout)
+            got_meta, arrays = npz_to_arrays(self.store.get(ptr.locator))
+            metadata.validate_arrays(arrays)
+            return got_meta, arrays
+        if ptr.kind == "inline":
+            arrays = [np.asarray(a) for a in ptr.inline or []]
+            metadata.validate_arrays(arrays)
+            return metadata, arrays
+        raise ValueError(f"unknown pointer kind {ptr.kind!r}")
+
+    # -- lifecycle -------------------------------------------------------
+    def free(self, ptr: ParamPointer) -> None:
+        """Release the payload behind a pointer (reference: Ray GC thread /
+        shm unlink after round, ``utils.py:73-144``)."""
+        if ptr.kind == "shm":
+            shm.unlink(ptr.locator)
+        elif ptr.kind == "objstore" and self.store is not None:
+            self.store.delete(ptr.locator)
+
+    def cleanup(self) -> None:
+        for name in self._owned:
+            if self.mode == "shm":
+                shm.unlink(name)
+            elif self.mode == "objstore" and self.store is not None:
+                self.store.delete(name)
+        self._owned.clear()

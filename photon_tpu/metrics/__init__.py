@@ -1,0 +1,23 @@
+"""Metrics & observability: round history, unigram-normalized LM metrics
+(reference: ``photon/wandb_history.py``, ``photon/metrics/``)."""
+
+from photon_tpu.metrics.history import History, make_wandb_run
+from photon_tpu.metrics.unigram import (
+    UNIGRAM_METRIC_NAMES,
+    UnigramMetricAccumulator,
+    model_cross_entropy,
+    pure_unigram_cross_entropy,
+    unigram_log_probs_from_counts,
+    unigram_normalized_cross_entropy,
+)
+
+__all__ = [
+    "History",
+    "make_wandb_run",
+    "UNIGRAM_METRIC_NAMES",
+    "UnigramMetricAccumulator",
+    "model_cross_entropy",
+    "pure_unigram_cross_entropy",
+    "unigram_normalized_cross_entropy",
+    "unigram_log_probs_from_counts",
+]

@@ -1,0 +1,60 @@
+"""Round-metric history with optional wandb mirroring.
+
+Reference: ``photon/wandb_history.py`` — a Flower ``History`` subclass that
+mirrors every recorded metric to wandb with ``step=server_round``. Here the
+history is a plain serializable record (it rides inside server checkpoints,
+reference: pickled history in ``state.bin``, ``s3_utils.py:348-548``) and the
+wandb mirror is gated on the package being importable + configured.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any
+
+
+class History:
+    def __init__(self, wandb_run: Any | None = None) -> None:
+        self.rounds: dict[str, list[tuple[int, float]]] = defaultdict(list)
+        self._wandb = wandb_run
+
+    def record(self, server_round: int, metrics: dict[str, float]) -> None:
+        for k, v in metrics.items():
+            try:
+                fv = float(v)
+            except (TypeError, ValueError):
+                continue
+            self.rounds[k].append((server_round, fv))
+        if self._wandb is not None:
+            self._wandb.log(dict(metrics), step=server_round)
+
+    def latest(self, key: str) -> float | None:
+        series = self.rounds.get(key)
+        return series[-1][1] if series else None
+
+    def series(self, key: str) -> list[tuple[int, float]]:
+        return list(self.rounds.get(key, []))
+
+    # -- checkpoint plumbing --------------------------------------------
+    def to_dict(self) -> dict:
+        return {k: list(v) for k, v in self.rounds.items()}
+
+    @classmethod
+    def from_dict(cls, d: dict, wandb_run: Any | None = None) -> "History":
+        h = cls(wandb_run)
+        for k, series in (d or {}).items():
+            h.rounds[k] = [(int(r), float(v)) for r, v in series]
+        return h
+
+
+def make_wandb_run(project: str | None, run_name: str, config: dict | None = None):
+    """Best-effort wandb init (reference: ``wandb_init``, gated here because
+    the image has no wandb / no egress)."""
+    if not project:
+        return None
+    try:
+        import wandb  # type: ignore
+
+        return wandb.init(project=project, name=run_name, config=config or {})
+    except Exception:  # noqa: BLE001 - any failure → metrics stay local
+        return None

@@ -1,0 +1,92 @@
+"""Unigram-normalized language-model metrics.
+
+Reference (``photon/metrics/unigram_normalized_metrics.py``): metrics that
+compare models *across vocabularies* by subtracting the entropy a unigram
+model achieves on the same tokens:
+
+- ``PureUnigramCrossEntropy``                 (``:12-93``): CE of the unigram
+  distribution itself on the targets;
+- ``UnigramNormalizedLanguageCrossEntropy``   (``:111-214``): model CE minus
+  unigram CE;
+- perplexity variants: ``exp`` of each.
+
+TPU-first: instead of torchmetrics subclass factories binding a probability
+tensor at runtime (``create_wrapped_subclass :233-256``), these are pure
+jittable functions over ``(logits, targets, unigram_log_probs)`` plus a tiny
+streaming accumulator for host-side aggregation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def pure_unigram_cross_entropy(targets, unigram_log_probs) -> jnp.ndarray:
+    """Mean CE of the unigram model on ``targets`` (any int array)."""
+    return -jnp.mean(unigram_log_probs[targets])
+
+
+def model_cross_entropy(logits, targets) -> jnp.ndarray:
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(logits.astype(jnp.float32), targets)
+    )
+
+
+def unigram_normalized_cross_entropy(logits, targets, unigram_log_probs) -> jnp.ndarray:
+    """Model CE − unigram CE. Negative = better than unigram by that many
+    nats/token; comparable across differing vocabularies."""
+    return model_cross_entropy(logits, targets) - pure_unigram_cross_entropy(
+        targets, unigram_log_probs
+    )
+
+
+UNIGRAM_METRIC_NAMES = (
+    "PureUnigramCrossEntropy",
+    "UnigramNormalizedLanguageCrossEntropy",
+    "UnigramNormalizedPerplexity",
+    "LanguageCrossEntropy",
+    "LanguagePerplexity",
+)
+
+
+@dataclasses.dataclass
+class UnigramMetricAccumulator:
+    """Streaming token-weighted accumulator over eval batches (the
+    torchmetrics ``update``/``compute`` analog, host side)."""
+
+    unigram_log_probs: np.ndarray
+    ce_sum: float = 0.0
+    uni_sum: float = 0.0
+    n_tokens: int = 0
+
+    def update(self, logits: np.ndarray, targets: np.ndarray) -> None:
+        n = int(np.size(targets))
+        self.ce_sum += float(model_cross_entropy(jnp.asarray(logits), jnp.asarray(targets))) * n
+        self.uni_sum += float(
+            pure_unigram_cross_entropy(jnp.asarray(targets), jnp.asarray(self.unigram_log_probs))
+        ) * n
+        self.n_tokens += n
+
+    def compute(self) -> dict[str, float]:
+        if self.n_tokens == 0:
+            return {}
+        ce = self.ce_sum / self.n_tokens
+        uni = self.uni_sum / self.n_tokens
+        norm = ce - uni
+        return {
+            "LanguageCrossEntropy": ce,
+            "LanguagePerplexity": float(np.exp(min(ce, 30.0))),
+            "PureUnigramCrossEntropy": uni,
+            "UnigramNormalizedLanguageCrossEntropy": norm,
+            "UnigramNormalizedPerplexity": float(np.exp(np.clip(norm, -30.0, 30.0))),
+        }
+
+
+def unigram_log_probs_from_counts(counts, vocab_size: int, smoothing: float = 1.0) -> np.ndarray:
+    from photon_tpu.data.unigram import probability_tensor
+
+    return np.log(probability_tensor(counts, vocab_size, smoothing))

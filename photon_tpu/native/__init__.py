@@ -1,0 +1,100 @@
+"""ctypes bindings for the native data-plane helpers (``native/photon_native.cpp``).
+
+Gracefully degrades: every function has a numpy fallback, so the framework
+works without ``make native`` — the lib just makes the loader/shm hot paths
+faster. The search order is the packaged ``lib/`` dir, the repo's ``native/``
+build dir, then ``PHOTON_NATIVE_LIB``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+
+import numpy as np
+
+_LIB = None
+
+
+def _find_lib() -> ctypes.CDLL | None:
+    candidates = []
+    if os.environ.get("PHOTON_NATIVE_LIB"):
+        candidates.append(pathlib.Path(os.environ["PHOTON_NATIVE_LIB"]))
+    here = pathlib.Path(__file__).resolve()
+    candidates.append(here.parent / "libphoton_native.so")
+    candidates.append(here.parents[2] / "native" / "libphoton_native.so")
+    for p in candidates:
+        if p.is_file():
+            try:
+                lib = ctypes.CDLL(str(p))
+            except OSError:
+                continue
+            lib.pts_gather_widen.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ]
+            lib.par_memcpy.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+            ]
+            lib.crc32.argtypes = [ctypes.c_uint32, ctypes.c_void_p, ctypes.c_int64]
+            lib.crc32.restype = ctypes.c_uint32
+            return lib
+    return None
+
+
+def get_lib() -> ctypes.CDLL | None:
+    global _LIB
+    if _LIB is None:
+        _LIB = _find_lib() or False
+    return _LIB or None
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+_N_THREADS = min(8, os.cpu_count() or 1)
+
+
+def gather_rows(row_arrays: list[np.ndarray], out: np.ndarray) -> None:
+    """Gather token rows (uint16/uint32 views into mmapped shards) into the
+    preallocated ``out [n, seq] int32`` batch."""
+    lib = get_lib()
+    n = len(row_arrays)
+    if n == 0:
+        return
+    if lib is None:
+        for i, r in enumerate(row_arrays):
+            out[i] = r
+        return
+    elem = row_arrays[0].dtype.itemsize
+    ptrs = (ctypes.c_void_p * n)(*(r.ctypes.data for r in row_arrays))
+    lib.pts_gather_widen(
+        ptrs, n, out.shape[1], elem,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), _N_THREADS,
+    )
+
+
+def parallel_memcpy(dst: memoryview | np.ndarray, src: memoryview | np.ndarray) -> None:
+    lib = get_lib()
+    d = np.frombuffer(dst, np.uint8) if isinstance(dst, memoryview) else dst.view(np.uint8).reshape(-1)
+    s = np.frombuffer(src, np.uint8) if isinstance(src, memoryview) else src.view(np.uint8).reshape(-1)
+    if lib is None:
+        np.copyto(d, s)
+        return
+    lib.par_memcpy(
+        d.ctypes.data_as(ctypes.c_void_p), s.ctypes.data_as(ctypes.c_void_p),
+        d.nbytes, _N_THREADS,
+    )
+
+
+def crc32(data: bytes | np.ndarray, seed: int = 0) -> int:
+    lib = get_lib()
+    if lib is None:
+        import zlib
+
+        buf = data if isinstance(data, bytes) else np.ascontiguousarray(data).tobytes()
+        return zlib.crc32(buf, seed)
+    arr = np.frombuffer(data, np.uint8) if isinstance(data, bytes) else np.ascontiguousarray(data).view(np.uint8).reshape(-1)
+    return int(lib.crc32(seed, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes))

@@ -55,7 +55,20 @@ def multihead_attention(
     impl: str = "pallas",
     causal: bool = True,
 ) -> jax.Array:
-    """Dispatch on ``impl`` ∈ {pallas, xla}. Falls back to XLA off-TPU."""
+    """Dispatch on ``impl`` ∈ {pallas, xla, ring}. Falls back to XLA off-TPU;
+    ``ring`` = context parallelism over the ambient mesh's ``sequence`` axis
+    (``photon_tpu/ops/ring_attention.py``), degrading to pallas/xla when the
+    axis is trivial."""
+    if impl == "ring":
+        from photon_tpu.ops.flash_attention import pallas_supported
+        from photon_tpu.ops.ring_attention import ring_attention
+        from photon_tpu.parallel.context import current_mesh
+
+        mesh = current_mesh()
+        inner = "pallas" if pallas_supported(q) else "xla"
+        if mesh is not None and mesh.shape.get("sequence", 1) > 1:
+            return ring_attention(q, k, v, mesh, causal=causal, impl=inner)
+        impl = inner
     if impl == "pallas":
         from photon_tpu.ops.flash_attention import flash_attention, pallas_supported
 

@@ -122,15 +122,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, scale, 
         lse_ref[0] = jnp.broadcast_to(lse[None, :], (SUBLANE, lse.shape[0]))
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_k):
+def _fwd(q, k, v, *, scale, causal, block_q, block_k, offset=None):
     bh, s_q, d = q.shape
     s_k = k.shape[1]
     n_q = pl.cdiv(s_q, block_q)
     n_k = pl.cdiv(s_k, block_k)
     grid = (bh, n_q, n_k)
 
+    # offset generalizes the causal mask to chunked/global positions:
+    # visible iff q_id + offset >= k_id (ring attention passes
+    # q_start - k_start; default aligns q to the end of k)
+    offset = s_k - s_q if offset is None else offset
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k, causal=causal, offset=s_k - s_q
+        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k, causal=causal, offset=offset
     )
     # lse carries SUBLANE redundant rows so its (1, 8, block_q) blocks are
     # exactly one fp32 tile; callers use row 0
@@ -365,3 +369,78 @@ def flash_attention(
     ob = _flash(qb, kb, vb, scale, causal, block_q, block_k)
     o = ob[..., :d].reshape(b, h, s_q, d)
     return jnp.transpose(o, (0, 2, 1, 3))
+
+
+# ---------------------------------------------------------------------------
+# LSE-returning variant (ring attention inner kernel)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, scale, causal, offset, block_q, block_k):
+    return _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k, offset=offset)
+
+
+def _flash_lse_fwd(q, k, v, scale, causal, offset, block_q, block_k):
+    o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k, offset=offset)
+    return (o, lse), (q, k, v)
+
+
+def _flash_lse_bwd(scale, causal, offset, block_q, block_k, res, cots):
+    """Exact backward for BOTH outputs (o, lse) by recomputing the chunk with
+    the differentiable XLA path. Ring attention's online-softmax merge takes
+    real gradients through lse, which the FlashAttention-2 backward (defined
+    only for the final normalized output) does not model — recompute does."""
+    q, k, v = res
+    from photon_tpu.ops.ring_attention import xla_chunk_attention
+
+    def chunk(q3, k3, v3):
+        # [bh, s, d] → [bh, s, 1, d] for the [b, s, h, d] chunk oracle;
+        # pass the kernel's scale explicitly (inputs are lane-padded, so
+        # 1/sqrt(padded_d) would be wrong)
+        o4, lse3 = xla_chunk_attention(
+            q3[:, :, None, :], k3[:, :, None, :], v3[:, :, None, :],
+            q_start=offset, k_start=0, causal=causal, scale=scale,
+        )
+        return o4[:, :, 0, :], lse3[:, :, 0]
+
+    _, vjp = jax.vjp(chunk, q, k, v)
+    return vjp(cots)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    q_start: int = 0,
+    k_start: int = 0,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> tuple[jax.Array, jax.Array]:
+    """Like :func:`flash_attention` but over global positions
+    (``q_start``/``k_start`` are the chunks' sequence offsets) and returning
+    ``(o [b,s,h,d], lse [b,s,h])`` for online-softmax merging across chunks."""
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    if s_q % block_q or s_k % block_k:
+        raise ValueError(f"seq lengths ({s_q},{s_k}) must divide blocks ({block_q},{block_k})")
+    scale = 1.0 / (d**0.5)
+    d_pad = max(LANE, ((d + LANE - 1) // LANE) * LANE)
+
+    def to_bh(x, s):
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+        if d_pad != d:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, d_pad - d)))
+        return x
+
+    qb, kb, vb = to_bh(q, s_q), to_bh(k, s_k), to_bh(v, s_k)
+    ob, lse = _flash_lse(qb, kb, vb, scale, causal, q_start - k_start, block_q, block_k)
+    o = jnp.transpose(ob[..., :d].reshape(b, h, s_q, d), (0, 2, 1, 3))
+    return o, jnp.transpose(lse.reshape(b, h, s_q), (0, 2, 1))

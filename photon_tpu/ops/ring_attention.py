@@ -1,0 +1,153 @@
+"""Ring attention: exact context parallelism over the ``sequence`` mesh axis.
+
+No reference analog — photon caps sequences at 2048 and has no CP/SP
+(SURVEY.md §5 "long-context: absent"); this is a TPU-first capability. The
+design follows blockwise ring attention: each device holds a contiguous
+sequence chunk of q/k/v; k/v chunks rotate around the ring via ``ppermute``
+over ICI, and per-chunk partial attention results are merged with
+log-sum-exp-weighted online-softmax combination — numerically identical to
+full attention, O(seq/n) memory per chip, and the compute of step t overlaps
+the transfer of step t+1 (XLA pipelines the independent ppermute/dot chains).
+
+Composes with GSPMD: :func:`ring_attention` is a ``shard_map`` region nested
+inside the jitted train step; everything outside stays compiler-partitioned.
+
+The inner per-chunk kernel is the blockwise Pallas flash kernel on TPU
+(``flash_attention_with_lse``) or the XLA oracle elsewhere; both take a
+*static* position offset — ring step and device index are static within the
+unrolled loop body, so no dynamic-shape or traced-mask machinery is needed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1.0e30
+
+
+def _merge_partials(o1, lse1, o2, lse2):
+    """Combine two partial attention results (online-softmax merge).
+
+    ``o_i``: [b, s, h, d] unnormalized-by-each-other partials (each already
+    normalized within its own chunk), ``lse_i``: [b, s, h] log-sum-exp.
+    """
+    m = jnp.maximum(lse1, lse2)
+    # fully-masked partials carry lse == NEG_INF → weight 0
+    w1 = jnp.where(lse1 > NEG_INF / 2, jnp.exp(lse1 - m), 0.0)
+    w2 = jnp.where(lse2 > NEG_INF / 2, jnp.exp(lse2 - m), 0.0)
+    denom = w1 + w2
+    safe = jnp.where(denom == 0.0, 1.0, denom)
+    o = (o1 * w1[..., None] + o2 * w2[..., None]) / safe[..., None]
+    lse = m + jnp.log(safe)
+    lse = jnp.where(denom == 0.0, jnp.full_like(lse, NEG_INF), lse)
+    return o.astype(o1.dtype), lse
+
+
+def xla_chunk_attention(q, k, v, *, q_start: int, k_start: int, causal: bool, scale: float | None = None):
+    """Per-chunk attention with global-position causal mask; returns
+    ``(o, lse)`` with fully-masked rows as ``(0, NEG_INF)``.
+
+    Shapes: q [b, sq, h, d], k/v [b, sk, h, d]; offsets are the chunks'
+    global sequence starts (static per ring step). ``scale`` overrides
+    ``1/sqrt(d)`` (the flash backward recompute passes the unpadded scale).
+    """
+    d = q.shape[-1]
+    scale = (1.0 / (d**0.5)) if scale is None else scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = jnp.arange(q.shape[1])[:, None] + q_start
+        k_pos = jnp.arange(k.shape[1])[None, :] + k_start
+        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    masked_all = m <= NEG_INF / 2
+    p = jnp.where(masked_all, 0.0, jnp.exp(s - jnp.where(masked_all, 0.0, m)))
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = jnp.einsum("bhqk,bkhd->bqhd", (p / l_safe).astype(v.dtype), v)
+    lse = jnp.where(masked_all[..., 0], NEG_INF, m[..., 0] + jnp.log(l_safe[..., 0]))
+    # lse: [b, h, sq] → [b, sq, h]
+    return o, jnp.transpose(lse, (0, 2, 1))
+
+
+def _chunk_attn(q, k, v, *, q_start, k_start, causal, impl):
+    if impl == "pallas":
+        from photon_tpu.ops.flash_attention import flash_attention_with_lse
+
+        return flash_attention_with_lse(q, k, v, causal=causal, q_start=q_start, k_start=k_start)
+    return xla_chunk_attention(q, k, v, q_start=q_start, k_start=k_start, causal=causal)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    impl: str = "xla",
+    axis_name: str = "sequence",
+    batch_axes: tuple[str, ...] = ("data", "fsdp"),
+    head_axis: str = "tensor",
+) -> jax.Array:
+    """Exact attention over sequence-sharded ``[b, s, h, d]`` inputs.
+
+    ``s`` is the GLOBAL sequence length; inside the shard_map each device
+    sees ``s / n_ring`` rows. Heads stay sharded on the ``tensor`` axis (the
+    spec names it, so TP composes — no gather at the shard_map boundary).
+    """
+    n_ring = mesh.shape[axis_name]
+    if n_ring == 1:
+        return _chunk_attn(q, k, v, q_start=0, k_start=0, causal=causal, impl=impl)[0]
+    s_global = q.shape[1]
+    if s_global % n_ring:
+        raise ValueError(f"seq {s_global} not divisible by ring size {n_ring}")
+    s_local = s_global // n_ring
+    h = q.shape[2]
+    h_axis = head_axis if head_axis in mesh.shape and h % mesh.shape[head_axis] == 0 else None
+    spec = P(batch_axes, axis_name, h_axis, None)
+
+    # one branch per (my_index, ring_step) is unrolled with STATIC offsets;
+    # lax.switch over axis_index picks the right branch at run time. n_ring is
+    # small (≤ #chips on the axis) so the unroll is cheap and each branch's
+    # inner kernel gets fully static masks.
+    def local(q_l, k_l, v_l):
+        idx = jax.lax.axis_index(axis_name)
+        perm = [(i, (i + 1) % n_ring) for i in range(n_ring)]
+
+        def step_branch(my_idx: int, t: int, q_l, k_c, v_c):
+            src = (my_idx - t) % n_ring
+            if causal and src > my_idx:
+                # statically dead: the whole k/v chunk is in this device's
+                # future — skip the kernel (≈half the ring FLOPs for causal).
+                # Outputs are built FROM the inputs (×0) so they carry the
+                # same varying-axes (vma) as the kernel branch — lax.switch
+                # requires all branches to agree.
+                zero = q_l * 0 + k_c[:, :1] * 0 + v_c[:, :1] * 0
+                lse = zero.sum(axis=-1).astype(jnp.float32) + NEG_INF
+                return zero, lse
+            return _chunk_attn(
+                q_l, k_c, v_c,
+                q_start=my_idx * s_local, k_start=src * s_local,
+                causal=causal, impl=impl,
+            )
+
+        o = jnp.zeros_like(q_l)
+        lse = jnp.full(q_l.shape[:2] + (q_l.shape[2],), NEG_INF, jnp.float32)
+        k_c, v_c = k_l, v_l
+        for t in range(n_ring):
+            branches = [
+                functools.partial(step_branch, i, t) for i in range(n_ring)
+            ]
+            o_c, lse_c = jax.lax.switch(idx, branches, q_l, k_c, v_c)
+            o, lse = _merge_partials(o, lse, o_c, lse_c)
+            if t + 1 < n_ring:
+                k_c = jax.lax.ppermute(k_c, axis_name, perm)
+                v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        return o
+
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)

@@ -56,4 +56,20 @@ def build_optimizer(
     chain = [opt]
     if ocfg.grad_clip_norm and ocfg.grad_clip_norm > 0:
         chain.insert(0, optax.clip_by_global_norm(ocfg.grad_clip_norm))
-    return optax.chain(*chain), schedule
+    tx = optax.chain(*chain)
+    if ocfg.freeze_patterns:
+        # frozen params get zero updates (reference: ``freeze_blocks``
+        # sets requires_grad=False, ``photon/utils.py:322-387``)
+        import re
+
+        regs = [re.compile(p) for p in ocfg.freeze_patterns]
+
+        def label(params):
+            from photon_tpu.codec import flatten_params, unflatten_params
+
+            names, leaves = flatten_params(params)
+            labels = ["freeze" if any(r.search(n) for r in regs) else "train" for n in names]
+            return unflatten_params(params, labels)
+
+        tx = optax.multi_transform({"train": tx, "freeze": optax.set_to_zero()}, label)
+    return tx, schedule

@@ -1,0 +1,106 @@
+"""Cross-slice collective aggregation: FedAvg as an allreduce over DCN/ICI.
+
+The marquee TPU-native path (SURVEY.md §7 stage 6): where the reference moves
+every client's full parameter list through S3/shm/Ray and averages on the
+server CPU (``strategy/aggregation.py:44-118``, ``s3_utils.py:730-1115``),
+TPU slices that are part of one ``jax.distributed`` job can aggregate with a
+single weighted ``psum`` over the ``clients`` mesh axis — no host round-trip,
+no object store, bandwidth = wire speed of ICI/DCN.
+
+Usage model: each client trains its slice; at the round boundary all clients
+enter :func:`collective_weighted_average` (an SPMD program over the joint
+mesh). Single-host tests fake the topology with CPU devices; multi-host runs
+build the same mesh from ``jax.distributed.initialize`` + per-process devices
+(``make_client_mesh``).
+
+Numerics: weights ``n_i / Σn`` are computed in fp32 from per-client sample
+counts; the weighted sum runs in fp32 regardless of param dtype — matching
+the reference's float accumulation (``aggregate_inplace``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+CLIENT_AXIS = "clients"
+
+
+def make_client_mesh(n_clients: int, devices: list | None = None) -> Mesh:
+    """1-D mesh with one entry per client slice-representative.
+
+    Multi-host: call after ``jax.distributed.initialize`` with the global
+    device list (one device per slice, e.g. each slice's device 0). The same
+    SPMD program then runs on every host and XLA routes the psum over DCN.
+    """
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) < n_clients:
+        raise ValueError(f"need {n_clients} devices for the client axis, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n_clients]), (CLIENT_AXIS,))
+
+
+def collective_weighted_average(
+    stacked_params: Any,
+    n_samples: jax.Array,
+    mesh: Mesh,
+) -> Any:
+    """Sample-weighted average over the client axis, one psum per pytree.
+
+    ``stacked_params``: pytree whose leaves are ``[n_clients, ...]`` arrays
+    sharded on the client axis (each slice contributes its row).
+    ``n_samples``: ``[n_clients] int`` sharded likewise.
+    Returns the averaged pytree (leaves ``[...]``, replicated) — every client
+    slice ends the round holding identical new globals, which also replaces
+    the reference's post-aggregation broadcast (``broadcast_utils.py``).
+    """
+
+    def local(ns, *leaves):
+        # ns: [1] local sample count; leaves: [1, ...] local client rows
+        n_total = jax.lax.psum(ns[0].astype(jnp.float32), CLIENT_AXIS)
+        w = ns[0].astype(jnp.float32) / n_total
+        return tuple(
+            jax.lax.psum(leaf[0].astype(jnp.float32) * w, CLIENT_AXIS) for leaf in leaves
+        )
+
+    flat, treedef = jax.tree_util.tree_flatten(stacked_params)
+    out_flat = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(CLIENT_AXIS),) + tuple(P(CLIENT_AXIS) for _ in flat),
+        out_specs=tuple(P() for _ in flat),
+    )(n_samples, *flat)
+    return jax.tree_util.tree_unflatten(treedef, list(out_flat))
+
+
+def collective_fedavg_round(
+    stacked_params: Any,
+    global_params: Any,
+    n_samples: jax.Array,
+    mesh: Mesh,
+    server_lr: float = 1.0,
+) -> Any:
+    """Full FedAvgEff round on device: weighted average → pseudo-gradient →
+    server SGD step (``x ← x − η(x − avg)``), all inside one jitted SPMD
+    program. With ``server_lr=1`` this is exact FedAvg. Adaptive server
+    optimizers keep their state host-side (strategy layer); this collective
+    path covers the FedAvg/Nesterov-μ=0 family where no server state exists
+    (the reference's federated default, ``conf/base.yaml:63-66``)."""
+    avg = collective_weighted_average(stacked_params, n_samples, mesh)
+    return jax.tree.map(
+        lambda x, a: (x.astype(jnp.float32) - server_lr * (x.astype(jnp.float32) - a)).astype(x.dtype),
+        global_params,
+        avg,
+    )
+
+
+def stack_for_clients(host_params_per_client: list[Any], mesh: Mesh) -> Any:
+    """Host-side helper (tests / single-host): stack per-client pytrees into
+    client-axis-sharded device arrays."""
+    stacked = jax.tree.map(lambda *xs: np.stack(xs), *host_params_per_client)
+    sharding = NamedSharding(mesh, P(CLIENT_AXIS))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)

@@ -120,6 +120,15 @@ def _parallel_copy(dst: memoryview, src: memoryview) -> None:
     if n <= _COPY_CHUNK:
         dst[:n] = src
         return
+    try:
+        # native multi-threaded memcpy when built (make native)
+        from photon_tpu.native import available, parallel_memcpy
+
+        if available():
+            parallel_memcpy(dst[:n], src)
+            return
+    except ImportError:
+        pass
     d = np.frombuffer(dst, np.uint8, count=n)
     s = np.frombuffer(src, np.uint8, count=n)
     futures = [

@@ -1,0 +1,174 @@
+"""Parameter-payload manipulation: momenta piggybacking, layer
+personalization/re-randomization, embedding transplant, payload checkers.
+
+Reference parity:
+- ``manipulate_pre_training_ndarrays`` splits an incoming ``[params|m1|m2]``
+  payload and personalizes/re-randomizes layers (``clients/utils.py:405-511``);
+- ``post_process_client_result`` re-appends momenta when ``aggregate_momenta``
+  (``clients/utils.py:514-652``);
+- ``parameters_checker`` asserts a payload actually changed/matched around
+  every set/get (``photon/utils.py:147-224``);
+- WTE embedding transplant (``photon/utils.py:543-599``);
+- ``randomize_layers`` / ``personalize_layers`` (``clients/utils.py:871-1008``).
+
+All functions operate on the codec's canonical (metadata, flat array list)
+form, so they compose with every transport/checkpoint path.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from photon_tpu.codec import ParamsMetadata
+
+M1_PREFIX = "__momenta_1__/"
+M2_PREFIX = "__momenta_2__/"
+
+
+# ---------------------------------------------------------------------------
+# momenta piggybacking ([params | m1 | m2] payloads)
+# ---------------------------------------------------------------------------
+
+
+def extend_with_momenta(
+    metadata: ParamsMetadata,
+    params: list[np.ndarray],
+    m1: list[np.ndarray] | None = None,
+    m2: list[np.ndarray] | None = None,
+) -> tuple[ParamsMetadata, list[np.ndarray]]:
+    """Append (or zero-init) first/second momenta to a parameter payload
+    (reference: zero momenta appended by ``get_raw_model_parameters``,
+    ``clients/utils.py:739-868``)."""
+    m1 = m1 if m1 is not None else [np.zeros_like(p, dtype=np.float32) for p in params]
+    m2 = m2 if m2 is not None else [np.zeros_like(p, dtype=np.float32) for p in params]
+    if len(m1) != len(params) or len(m2) != len(params):
+        raise ValueError("momenta length mismatch")
+    names = (
+        list(metadata.names)
+        + [M1_PREFIX + n for n in metadata.names]
+        + [M2_PREFIX + n for n in metadata.names]
+    )
+    arrays = list(params) + list(m1) + list(m2)
+    return ParamsMetadata.from_ndarrays(names, arrays), arrays
+
+
+def has_momenta(metadata: ParamsMetadata) -> bool:
+    return any(n.startswith(M1_PREFIX) for n in metadata.names)
+
+
+def split_momenta(
+    metadata: ParamsMetadata, arrays: list[np.ndarray]
+) -> tuple[ParamsMetadata, list[np.ndarray], list[np.ndarray], list[np.ndarray]]:
+    """Inverse of :func:`extend_with_momenta` (reference payload split,
+    ``clients/utils.py:405-511``)."""
+    if len(arrays) % 3 or not has_momenta(metadata):
+        raise ValueError("payload does not carry momenta")
+    n = len(arrays) // 3
+    base = ParamsMetadata.from_ndarrays(metadata.names[:n], arrays[:n])
+    for name, expect in zip(metadata.names[n : 2 * n], base.names):
+        if name != M1_PREFIX + expect:
+            raise ValueError(f"momenta section misaligned at {name!r}")
+    return base, arrays[:n], arrays[n : 2 * n], arrays[2 * n :]
+
+
+# ---------------------------------------------------------------------------
+# layer selection / rewriting
+# ---------------------------------------------------------------------------
+
+
+def match_indices(metadata: ParamsMetadata, patterns: list[str]) -> list[int]:
+    regs = [re.compile(p) for p in patterns]
+    return [i for i, n in enumerate(metadata.names) if any(r.search(n) for r in regs)]
+
+
+def randomize_layers(
+    metadata: ParamsMetadata,
+    arrays: list[np.ndarray],
+    patterns: list[str],
+    seed: int,
+    stddev: float = 0.02,
+) -> list[np.ndarray]:
+    """Fresh-init matching layers (reference ``randomize_layers``,
+    ``clients/utils.py:871-1008``): scale-like 1-D tensors reset to ones,
+    everything else to N(0, stddev)."""
+    rng = np.random.default_rng(seed)
+    out = list(arrays)
+    for i in match_indices(metadata, patterns):
+        a = arrays[i]
+        if a.ndim <= 1 and "scale" in metadata.names[i]:
+            out[i] = np.ones_like(a)
+        else:
+            out[i] = rng.normal(0.0, stddev, a.shape).astype(a.dtype)
+    return out
+
+
+def personalize_layers(
+    metadata: ParamsMetadata,
+    incoming: list[np.ndarray],
+    local: list[np.ndarray] | None,
+    patterns: list[str],
+) -> list[np.ndarray]:
+    """Keep the client's own values for matching layers instead of the
+    server's (reference ``personalize_layers``)."""
+    if local is None:
+        return list(incoming)
+    out = list(incoming)
+    for i in match_indices(metadata, patterns):
+        out[i] = local[i]
+    return out
+
+
+def transplant_embeddings(
+    metadata: ParamsMetadata,
+    arrays: list[np.ndarray],
+    donor_metadata: ParamsMetadata,
+    donor_arrays: list[np.ndarray],
+    pattern: str = r"wte/embedding$",
+) -> list[np.ndarray]:
+    """Copy token-embedding rows from a donor payload (reference WTE
+    transplant, ``photon/utils.py:543-599``); row counts may differ — the
+    overlap is copied."""
+    targets = match_indices(metadata, [pattern])
+    donors = match_indices(donor_metadata, [pattern])
+    if not targets or not donors:
+        raise ValueError(f"no embedding matching {pattern!r}")
+    out = list(arrays)
+    for ti, di in zip(targets, donors):
+        dst, src = arrays[ti].copy(), donor_arrays[di]
+        rows = min(dst.shape[0], src.shape[0])
+        if dst.shape[1:] != src.shape[1:]:
+            raise ValueError(f"embedding width mismatch {dst.shape} vs {src.shape}")
+        dst[:rows] = src[:rows]
+        out[ti] = dst
+    return out
+
+
+# ---------------------------------------------------------------------------
+# payload checkers
+# ---------------------------------------------------------------------------
+
+
+def parameters_checker(
+    a: list[np.ndarray],
+    b: list[np.ndarray],
+    expect_equal: bool,
+    rtol: float = 1e-6,
+    atol: float = 1e-8,
+) -> None:
+    """Assert two payloads are (not) numerically identical (reference
+    ``parameters_checker``, ``photon/utils.py:147-224``). Raises ValueError
+    with the first offending layer index."""
+    if len(a) != len(b):
+        raise ValueError(f"length mismatch {len(a)} vs {len(b)}")
+    if expect_equal:
+        for i, (x, y) in enumerate(zip(a, b)):
+            if x.shape != y.shape or not np.allclose(x, y, rtol=rtol, atol=atol):
+                raise ValueError(f"payloads differ at array {i} (expected equal)")
+    else:
+        if all(
+            x.shape == y.shape and np.allclose(x, y, rtol=rtol, atol=atol)
+            for x, y in zip(a, b)
+        ):
+            raise ValueError("payloads identical (expected a change)")

@@ -83,16 +83,31 @@ class Trainer:
             lambda leaf, sh: jax.device_put(leaf, sh), host_state, self._shardings
         )
         self._batch_sharding = NamedSharding(self.mesh, batch_spec(self.mesh))
-        self._train_step = jax.jit(
+        jitted_train = jax.jit(
             step_fn,
             in_shardings=(self._shardings, self._batch_sharding),
             out_shardings=(self._shardings, None),
             donate_argnums=0,
         )
-        self._eval_step = jax.jit(
+        jitted_eval = jax.jit(
             make_eval_step(self.model),
             in_shardings=(self._shardings.params, self._batch_sharding),
         )
+
+        # tracing may build shard_map regions (ring attention) that need the
+        # concrete mesh — publish it for the duration of each call
+        from photon_tpu.parallel.context import use_mesh
+
+        def _train(state, batch):
+            with use_mesh(self.mesh):
+                return jitted_train(state, batch)
+
+        def _eval(params, batch):
+            with use_mesh(self.mesh):
+                return jitted_eval(params, batch)
+
+        self._train_step = _train
+        self._eval_step = _eval
 
     # ------------------------------------------------------------------
     # training / eval loops
@@ -190,6 +205,121 @@ class Trainer:
         )
         self.state = self.state.replace(params=new_params)
         self._last_set_time = time.monotonic() - t0
+
+    def get_opt_state_arrays(self) -> tuple[ParamsMetadata, list[np.ndarray]]:
+        """Flatten optimizer state to the canonical (metadata, arrays) form —
+        client checkpoints persist the full TrainState (reference: Composer
+        checkpoint includes optimizer state, ``llm_config_functions.py:642-764``)."""
+        from photon_tpu.codec import params_to_ndarrays
+
+        return params_to_ndarrays(self.state.opt_state)
+
+    def set_opt_state_arrays(self, metadata: ParamsMetadata, arrays: list[np.ndarray]) -> None:
+        from photon_tpu.codec import params_from_ndarrays
+
+        host_opt = params_from_ndarrays(self.state.opt_state, metadata, arrays)
+        # preserve original leaf dtypes (counters are int32; npz round-trips
+        # shapes/dtypes so this is a safety cast only for () scalars)
+        new_opt = jax.tree.map(
+            lambda new, old, sh: jax.device_put(
+                np.asarray(new, dtype=old.dtype).reshape(np.shape(old)), sh
+            ),
+            host_opt,
+            self.state.opt_state,
+            self._shardings.opt_state,
+        )
+        self.state = self.state.replace(opt_state=new_opt)
+
+    def _moment_trees(self):
+        """Locate (first, second) moment pytrees in the chained opt state
+        (AdoptState.m/.v or optax ScaleByAdamState.mu/.nu)."""
+        found = {}
+
+        def visit(node):
+            if hasattr(node, "m") and hasattr(node, "v"):
+                found.setdefault("m1", node.m)
+                found.setdefault("m2", node.v)
+            elif hasattr(node, "mu") and hasattr(node, "nu"):
+                found.setdefault("m1", node.mu)
+                found.setdefault("m2", node.nu)
+            elif isinstance(node, dict):
+                for sub in node.values():
+                    visit(sub)
+            elif hasattr(node, "inner_states"):  # optax MultiTransformState
+                visit(node.inner_states)
+            elif hasattr(node, "inner_state"):  # optax MaskedState / wrappers
+                visit(node.inner_state)
+            elif isinstance(node, (tuple, list)):
+                for sub in node:
+                    visit(sub)
+
+        visit(self.state.opt_state)
+        if "m1" not in found:
+            raise RuntimeError("optimizer state carries no recognizable moments")
+        return found["m1"], found["m2"]
+
+    @staticmethod
+    def _is_masked(leaf) -> bool:
+        import optax
+
+        return isinstance(leaf, optax.MaskedNode)
+
+    def get_momenta(self) -> tuple[list[np.ndarray], list[np.ndarray]]:
+        """First/second optimizer moments as flat lists in codec order
+        (reference momenta export when ``aggregate_momenta``,
+        ``clients/utils.py:514-652``). Frozen params (``freeze_patterns`` →
+        optax MaskedNode with no state) report zero moments."""
+        from photon_tpu.codec import params_to_ndarrays
+
+        m1_tree, m2_tree = self._moment_trees()
+
+        p_leaves, p_def = jax.tree_util.tree_flatten(self.state.params)
+
+        def densify(tree):
+            m_leaves = jax.tree_util.tree_flatten(tree, is_leaf=self._is_masked)[0]
+            if len(m_leaves) != len(p_leaves):
+                raise RuntimeError("moment tree does not mirror the param tree")
+            dense = [
+                np.zeros(np.shape(p), np.float32) if self._is_masked(m) else m
+                for p, m in zip(p_leaves, m_leaves)
+            ]
+            return jax.tree_util.tree_unflatten(p_def, dense)
+
+        return params_to_ndarrays(densify(m1_tree))[1], params_to_ndarrays(densify(m2_tree))[1]
+
+    def set_momenta(self, m1: list[np.ndarray], m2: list[np.ndarray]) -> None:
+        """Inject server-aggregated moments into the live optimizer state
+        (reference ``set_optimizer_state``, ``clients/utils.py:257-402``).
+        ``m1``/``m2`` are in codec (sorted-name) order; values for frozen
+        params (MaskedNode slots) are ignored."""
+        from photon_tpu.codec import unflatten_params
+
+        m1_tree, m2_tree = self._moment_trees()
+        # codec order → param-tree order
+        dense_m1 = jax.tree.leaves(unflatten_params(self.state.params, list(m1)))
+        dense_m2 = jax.tree.leaves(unflatten_params(self.state.params, list(m2)))
+
+        def build_value_map(tree, dense):
+            leaves = jax.tree_util.tree_flatten(tree, is_leaf=self._is_masked)[0]
+            if len(leaves) != len(dense):
+                raise RuntimeError("moment tree does not mirror the param tree")
+            return {
+                id(old): new
+                for old, new in zip(leaves, dense)
+                if not self._is_masked(old)
+            }
+
+        values = build_value_map(m1_tree, dense_m1)
+        values.update(build_value_map(m2_tree, dense_m2))
+
+        def replace(leaf, sh):
+            new = values.get(id(leaf))
+            if new is None:
+                return leaf
+            return jax.device_put(np.asarray(new, dtype=leaf.dtype).reshape(np.shape(leaf)), sh)
+
+        new_opt = jax.tree.map(replace, self.state.opt_state, self._shardings.opt_state)
+        self.state = self.state.replace(opt_state=new_opt)
 
     def reset_optimizer(self) -> None:
         """Drop optimizer state, keep params/step (reference reset knob:

@@ -1,0 +1,93 @@
+"""Profiling & speed telemetry.
+
+Reference (SURVEY.md §5): Composer's Profiler with cyclic schedule + JSON
+trace handler, llm-foundry ``speed_monitor``/``runtime_estimator`` callbacks,
+and photon's manual ``time.time_ns()`` spans. TPU equivalents:
+
+- :func:`trace` — ``jax.profiler`` trace context writing TensorBoard-format
+  traces (xplane) to a directory;
+- :class:`Timer` — named wall-clock spans exported with the reference's
+  metric names;
+- :func:`model_flops_per_token` / :class:`SpeedMonitor` — tokens/sec and MFU
+  against a configurable peak (defaults to TPU v5e bf16 peak).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+from photon_tpu.config.schema import ModelConfig
+
+TPU_V5E_PEAK_FLOPS = 197e12  # bf16
+TPU_V4_PEAK_FLOPS = 275e12
+A100_PEAK_FLOPS = 312e12
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, enabled: bool = True) -> Iterator[None]:
+    """jax.profiler trace context (reference: Composer Profiler,
+    ``trainer_utils.py:1456-1482``)."""
+    if not enabled:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Timer:
+    """Named wall-clock spans → metrics dict (reference: manual ns spans,
+    e.g. ``client/fit_time`` ``llm_client_functions.py:205-209``)."""
+
+    def __init__(self) -> None:
+        self.metrics: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.metrics[name] = self.metrics.get(name, 0.0) + time.monotonic() - t0
+
+
+def model_flops_per_token(cfg: ModelConfig) -> float:
+    """Training FLOPs/token ≈ 6·N_nonemb + 12·L·d·s (attention) + 6·d·V
+    (tied lm_head). Matches the estimate used for BASELINE vs_baseline."""
+    d, L, s, v = cfg.d_model, cfg.n_layers, cfg.max_seq_len, cfg.vocab_size
+    n_block = L * (4 * d * d + 2 * d * cfg.expansion_ratio * d)  # qkv+proj / mlp
+    attn = 12 * L * d * s  # score + value matmuls, fwd+bwd
+    head = 6 * d * v
+    return 6.0 * n_block + attn + head
+
+
+class SpeedMonitor:
+    """EMA tokens/sec + MFU (reference: llm-foundry ``speed_monitor``
+    callback, ``mpt-125m.yaml:98-109``)."""
+
+    def __init__(self, cfg: ModelConfig, peak_flops: float = TPU_V5E_PEAK_FLOPS,
+                 n_chips: int = 1, alpha: float = 0.9) -> None:
+        self.flops_per_token = model_flops_per_token(cfg)
+        self.peak = peak_flops * n_chips
+        self.alpha = alpha
+        self._ema = 0.0
+        self._t = 0
+
+    def update(self, tokens: int, seconds: float) -> dict[str, float]:
+        if seconds <= 0:
+            return {}
+        tps = tokens / seconds
+        self._t += 1
+        self._ema = self.alpha * self._ema + (1 - self.alpha) * tps
+        ema = self._ema / (1 - self.alpha**self._t)
+        return {
+            "throughput/tokens_per_sec": tps,
+            "throughput/tokens_per_sec_ema": ema,
+            "throughput/mfu": tps * self.flops_per_token / self.peak,
+        }

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Centralized 125M recipe (reference: scripts/cen_125m_example.sh —
+# 4800 steps × GBS 256 × 2048 tokens ≈ 2.52B tokens, ADOPT 6e-4).
+set -euo pipefail
+DATA_PATH=${DATA_PATH:-}
+SAVE_PATH=${SAVE_PATH:-/tmp/photon_tpu_cen125m}
+STEPS=${STEPS:-4800}
+
+args=(
+  --steps "$STEPS"
+  --eval-interval 500
+  --set "photon.save_path=$SAVE_PATH"
+)
+if [[ -n "$DATA_PATH" ]]; then
+  args+=(--set "dataset.local_path=$DATA_PATH")
+else
+  args+=(--set dataset.synthetic=true)
+fi
+exec python -m photon_tpu.centralized "${args[@]}" "$@"

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# HF C4 → 8-client PTS shards (reference: scripts/convert_c4_dataset.sh).
+# Requires the `datasets` package + network; for offline use pass local
+# jsonl files via TEXT_FILES.
+set -euo pipefail
+OUT=${OUT:-/tmp/photon_tpu_c4_8c}
+N_CLIENTS=${N_CLIENTS:-8}
+TEXT_FILES=${TEXT_FILES:-}
+
+if [[ -n "$TEXT_FILES" ]]; then
+  exec python -m photon_tpu.data.convert --text-files $TEXT_FILES \
+    --tokenizer gpt2 --out "$OUT" --n-clients "$N_CLIENTS" --seq-len 2048 "$@"
+fi
+exec python -m photon_tpu.data.convert --hf-dataset allenai/c4 --hf-config en \
+  --tokenizer gpt2 --out "$OUT" --n-clients "$N_CLIENTS" --seq-len 2048 "$@"

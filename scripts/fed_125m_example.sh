@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Federated 125M recipe (reference: scripts/fed_125m_example.sh —
+# 8 clients, 8/round, local batch 32, 128 local steps, 320 rounds, FedAvg
+# via NESTOROV lr=1.0 μ=0.0). TPU-native: one process drives the host mesh;
+# no superlink/broker pipeline to assemble.
+set -euo pipefail
+DATA_PATH=${DATA_PATH:-}          # PTS root with client_{i}/train; empty = synthetic
+SAVE_PATH=${SAVE_PATH:-/tmp/photon_tpu_fed125m}
+ROUNDS=${ROUNDS:-320}
+
+args=(
+  --preset mpt-125m
+  --rounds "$ROUNDS"
+  --set fl.n_total_clients=8
+  --set fl.n_clients_per_round=8
+  --set fl.local_steps=128
+  --set fl.strategy_name=nesterov
+  --set fl.server_learning_rate=1.0
+  --set fl.server_momentum=0.0
+  --set train.global_batch_size=32
+  --set "photon.save_path=$SAVE_PATH"
+)
+if [[ -n "$DATA_PATH" ]]; then
+  args+=(--set "dataset.local_path=$DATA_PATH")
+else
+  args+=(--set dataset.synthetic=true)
+fi
+exec python -m photon_tpu.federated "${args[@]}" "$@"

@@ -1,19 +1,45 @@
 """Test harness: fake an 8-device TPU-like mesh on CPU.
 
 SURVEY.md §4: the reference ships no tests; we build the pyramid ourselves.
-Multi-chip behavior is tested on a virtual CPU device mesh
-(``xla_force_host_platform_device_count``), per the driver's contract.
+Multi-chip behavior is tested on a virtual 8-CPU-device mesh
+(``jax.config jax_num_cpu_devices``), per the driver's contract.
 """
 
-import os
+import jax
 
-# force CPU: the env may preset JAX_PLATFORMS to the (single, tunneled) TPU
-# chip, which tests must never contend for
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
+# Force CPU via jax.config (not env vars): the image's site hook pre-imports
+# jax and registers the real (single, tunneled) TPU chip, so env vars set here
+# are read too late. jax.config.update works any time before backend init.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_threefry_partitionable", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: multiprocess / long-compile tests")
+
+
+@pytest.fixture(scope="module")
+def tiny_trainer():
+    """A single-device Trainer on a tiny model + one synthetic batch."""
+    from photon_tpu.config.schema import (
+        Config, MeshConfig, ModelConfig, OptimizerConfig, SchedulerConfig, TrainConfig,
+    )
+    from photon_tpu.train.trainer import Trainer
+
+    cfg = Config(
+        model=ModelConfig(
+            d_model=32, n_layers=2, n_heads=2, max_seq_len=16, vocab_size=64,
+            attn_impl="xla", compute_dtype="float32",
+        ),
+        mesh=MeshConfig(),
+        optimizer=OptimizerConfig(name="adopt", lr=1e-3),
+        scheduler=SchedulerConfig(t_warmup=2, t_max=50),
+        train=TrainConfig(global_batch_size=4, device_microbatch_size=4),
+    )
+    trainer = Trainer(cfg, init_seed=0)
+    batch = np.random.default_rng(0).integers(0, 64, (4, 16), dtype=np.int64)
+    return trainer, batch

@@ -1,0 +1,146 @@
+"""Checkpoint subsystem tests: store atomicity/listing, server round
+save/resume (negative indexing, validity, GC, cross-run import), client
+skip-if-done semantics."""
+
+import numpy as np
+import pytest
+
+from photon_tpu.checkpoint import (
+    ClientCheckpointManager,
+    FileStore,
+    ServerCheckpointManager,
+    arrays_to_npz,
+    npz_to_arrays,
+)
+from photon_tpu.codec import ParamsMetadata
+
+
+def _params(seed=0, n=3):
+    rng = np.random.default_rng(seed)
+    arrays = [rng.normal(size=(4, 2)).astype(np.float32) for _ in range(n)]
+    names = [f"layer_{i}/w" for i in range(n)]
+    return ParamsMetadata.from_ndarrays(names, arrays), arrays
+
+
+def test_filestore_roundtrip(tmp_path):
+    s = FileStore(tmp_path / "store")
+    s.put("a/b/c.bin", b"hello")
+    assert s.exists("a/b/c.bin")
+    assert s.get("a/b/c.bin") == b"hello"
+    s.put("a/b/d.bin", b"x")
+    assert s.list("a") == ["a/b/c.bin", "a/b/d.bin"]
+    s.delete("a/b/c.bin")
+    assert not s.exists("a/b/c.bin")
+    with pytest.raises(ValueError):
+        s.put("../escape", b"no")
+
+
+def test_npz_roundtrip_preserves_order_and_dtypes():
+    meta, arrays = _params()
+    arrays[1] = arrays[1].astype(np.float64)
+    meta = ParamsMetadata.from_ndarrays(meta.names, arrays)
+    m2, a2 = npz_to_arrays(arrays_to_npz(meta, arrays))
+    assert m2.names == meta.names
+    for x, y in zip(arrays, a2):
+        np.testing.assert_array_equal(x, y)
+        assert x.dtype == y.dtype
+
+
+def test_server_checkpoint_save_load_resume(tmp_path):
+    store = FileStore(tmp_path)
+    mgr = ServerCheckpointManager(store, "run1")
+    meta, params = _params()
+    keys = ("momentum",)
+    for r in [0, 1, 2]:
+        momenta = [np.full_like(a, r) for a in params]
+        mgr.save_round(r, meta, params, {"momentum": momenta}, {"round": r, "steps": r * 128})
+    assert mgr.list_rounds() == [0, 1, 2]
+    assert mgr.valid_rounds(keys) == [0, 1, 2]
+
+    # negative resume indexing
+    assert mgr.resolve_resume_round(-1, keys) == 2
+    assert mgr.resolve_resume_round(-2, keys) == 1
+    assert mgr.resolve_resume_round(1, keys) == 1
+    with pytest.raises(FileNotFoundError):
+        mgr.resolve_resume_round(7, keys)
+    with pytest.raises(FileNotFoundError):
+        mgr.resolve_resume_round(-5, keys)
+
+    m, p, st, server_state = mgr.load_round(2, keys)
+    assert m.names == meta.names
+    np.testing.assert_array_equal(st["momentum"][0], np.full_like(params[0], 2))
+    assert server_state == {"round": 2, "steps": 256}
+
+
+def test_server_checkpoint_validity_and_gc(tmp_path):
+    store = FileStore(tmp_path)
+    mgr = ServerCheckpointManager(store, "run1")
+    meta, params = _params()
+    keys = ("momentum",)
+    for r in range(5):
+        mgr.save_round(r, meta, params, {"momentum": params}, {})
+    # corrupt round 3: missing momentum -> invalid
+    store.delete("run1/server/3/momentum.npz")
+    assert mgr.valid_rounds(keys) == [0, 1, 2, 4]
+    assert mgr.resolve_resume_round(-2, keys) == 2
+
+    deleted = mgr.cleanup(keep=2, state_keys=keys)
+    assert 3 in deleted  # partial round removed too
+    assert mgr.valid_rounds(keys) == [2, 4]
+
+
+def test_cross_run_import(tmp_path):
+    store = FileStore(tmp_path)
+    old = ServerCheckpointManager(store, "old_run")
+    meta, params = _params()
+    old.save_round(4, meta, params, {}, {"round": 4})
+    new = ServerCheckpointManager(store, "new_run")
+    assert new.import_run("old_run") == [4]
+    _, p, _, st = new.load_round(4)
+    np.testing.assert_array_equal(p[0], params[0])
+    assert st["round"] == 4
+
+
+def test_client_checkpoint_skip_if_done(tmp_path):
+    store = FileStore(tmp_path)
+    mgr = ClientCheckpointManager(store, "run1")
+    meta, params = _params()
+    for step in [128, 256, 384]:
+        mgr.save(cid=3, step=step, params_meta=meta, params=params,
+                 extra_state={"loader": {"epoch": 0, "sample_in_epoch": step}})
+    assert mgr.steps(3) == [128, 256, 384]
+    assert mgr.latest_at_most(3, 300) == 256
+    assert mgr.latest_at_most(3, 100) is None
+    assert mgr.should_skip_round(3, 384)
+    assert not mgr.should_skip_round(3, 512)
+
+    _, p, opt, state = mgr.load(3, 256)
+    assert opt is None
+    assert state["loader"]["sample_in_epoch"] == 256
+
+    assert mgr.cleanup(3, keep=1) == [128, 256]
+    assert mgr.steps(3) == [384]
+
+
+def test_trainer_opt_state_roundtrip(tiny_trainer):
+    """Full TrainState round-trip through the checkpoint arrays path."""
+    trainer, batch = tiny_trainer
+    trainer.fit([batch, batch], duration_steps=2)
+    om, oa = trainer.get_opt_state_arrays()
+    pm, pa = trainer.get_parameters()
+    step = trainer.step
+
+    trainer2_m, trainer2_a = trainer.get_opt_state_arrays()
+    trainer.reset_optimizer()
+    changed = any(
+        not np.array_equal(x, y)
+        for x, y in zip(oa, trainer.get_opt_state_arrays()[1])
+    )
+    assert changed  # moments were non-zero after 2 steps
+
+    trainer.set_opt_state_arrays(om, oa)
+    trainer.set_parameters(pm, pa)
+    trainer.set_step(step)
+    for x, y in zip(oa, trainer.get_opt_state_arrays()[1]):
+        np.testing.assert_allclose(x, y, rtol=1e-6)
+    del trainer2_m, trainer2_a

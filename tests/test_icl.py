@@ -1,0 +1,65 @@
+"""ICL gauntlet harness tests: task parsing, MC scoring correctness with a
+rigged model, gauntlet aggregation with random-baseline subtraction."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.tokenizer import ByteTokenizer
+from photon_tpu.eval import ICLTask, make_logprob_fn, run_gauntlet
+
+VOCAB = 257
+SEQ = 32
+
+
+def _apply(params, tokens):
+    """Deterministic fake model (jit-traceable): next byte = current + 1."""
+    nxt = (tokens + 1) % VOCAB
+    return 20.0 * jax.nn.one_hot(nxt, VOCAB, dtype=jnp.float32) - 10.0
+
+
+def test_task_from_jsonl(tmp_path):
+    rows = [{"query": "q", "choices": ["a", "b"], "gold": 0}] * 3
+    p = tmp_path / "mc.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows))
+    task = ICLTask.from_jsonl(p, category="knowledge")
+    assert task.kind == "multiple_choice"
+    assert task.random_baseline == 0.5
+    assert task.name == "mc"
+
+
+def test_mc_scoring_prefers_predictable_continuation(tmp_path):
+    tok = ByteTokenizer()
+    # bigram model loves ascending byte runs: "abcd" -> "efgh" is predictable
+    rows = [
+        {"query": "abcd", "choices": ["efgh", "zzzz"], "gold": 0},
+        {"query": "mnop", "choices": ["xxxx", "qrst"], "gold": 1},
+    ]
+    task = ICLTask("asc", "multiple_choice", rows, "synthetic", 0.5)
+    out = run_gauntlet([task], tok, _apply, params=None, seq_len=SEQ, batch_size=8)
+    assert out["icl/asc/accuracy"] == 1.0
+    # baseline-subtracted, rescaled: (1.0 - 0.5)/0.5 = 1.0
+    assert out["icl/category/synthetic"] == 1.0
+    assert out["icl/average"] == 1.0
+
+
+def test_lm_task_logprob(tmp_path):
+    tok = ByteTokenizer()
+    rows = [{"context": "abc", "continuation": "def"}]
+    task = ICLTask("lm", "language_modeling", rows)
+    logprob_fn = make_logprob_fn(_apply, None, SEQ)
+    from photon_tpu.eval.icl import evaluate_task
+
+    res = evaluate_task(task, tok, logprob_fn, SEQ, batch_size=4)
+    # perfectly predicted continuation: logprob/token ≈ log softmax(10 vs -10) ≈ 0
+    assert res["logprob_per_token"] > -0.01
+
+
+def test_gauntlet_floor_at_zero():
+    tok = ByteTokenizer()
+    rows = [{"query": "abcd", "choices": ["zzzz", "efgh"], "gold": 0}]  # model picks wrong
+    task = ICLTask("bad", "multiple_choice", rows, "synthetic", 0.5)
+    out = run_gauntlet([task], tok, _apply, None, seq_len=SEQ, batch_size=8)
+    assert out["icl/bad/accuracy"] == 0.0
+    assert out["icl/category/synthetic"] == 0.0  # clamped, not negative

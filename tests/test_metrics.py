@@ -1,0 +1,83 @@
+"""Unigram-normalized metrics + history tests (reference oracles:
+``photon/metrics/unigram_normalized_metrics.py`` semantics — normalized CE =
+model CE − unigram CE; history mirrors rounds)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from photon_tpu.metrics import (
+    History,
+    UnigramMetricAccumulator,
+    model_cross_entropy,
+    pure_unigram_cross_entropy,
+    unigram_log_probs_from_counts,
+    unigram_normalized_cross_entropy,
+)
+
+
+def test_pure_unigram_ce_uniform():
+    """Uniform unigram distribution → CE = log(vocab)."""
+    vocab = 16
+    logp = np.full(vocab, -np.log(vocab), np.float32)
+    targets = jnp.asarray(np.random.default_rng(0).integers(0, vocab, (4, 8)))
+    ce = float(pure_unigram_cross_entropy(targets, jnp.asarray(logp)))
+    np.testing.assert_allclose(ce, np.log(vocab), rtol=1e-6)
+
+
+def test_normalized_ce_is_difference():
+    rng = np.random.default_rng(1)
+    vocab = 16
+    logits = jnp.asarray(rng.normal(size=(2, 8, vocab)), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, vocab, (2, 8)))
+    logp = jnp.asarray(np.log(np.full(vocab, 1.0 / vocab, np.float32)))
+    norm = float(unigram_normalized_cross_entropy(logits, targets, logp))
+    ce = float(model_cross_entropy(logits, targets))
+    uni = float(pure_unigram_cross_entropy(targets, logp))
+    np.testing.assert_allclose(norm, ce - uni, rtol=1e-6)
+
+
+def test_perfect_model_beats_unigram():
+    """A model with all mass on the target must have negative normalized CE."""
+    vocab = 8
+    targets = np.asarray([[1, 2, 3]])
+    logits = np.full((1, 3, vocab), -100.0, np.float32)
+    for i, t in enumerate(targets[0]):
+        logits[0, i, t] = 100.0
+    logp = np.log(np.full(vocab, 1.0 / vocab, np.float32))
+    norm = float(unigram_normalized_cross_entropy(jnp.asarray(logits), jnp.asarray(targets), jnp.asarray(logp)))
+    assert norm < -1.0
+
+
+def test_accumulator_token_weighted():
+    from collections import Counter
+
+    vocab = 8
+    logp = unigram_log_probs_from_counts(Counter({i: 1 for i in range(vocab)}), vocab)
+    acc = UnigramMetricAccumulator(unigram_log_probs=logp)
+    rng = np.random.default_rng(2)
+    for n in (4, 12):  # different batch sizes → weighting matters
+        logits = rng.normal(size=(1, n, vocab)).astype(np.float32)
+        targets = rng.integers(0, vocab, (1, n))
+        acc.update(logits, targets)
+    out = acc.compute()
+    assert set(out) == {
+        "LanguageCrossEntropy", "LanguagePerplexity", "PureUnigramCrossEntropy",
+        "UnigramNormalizedLanguageCrossEntropy", "UnigramNormalizedPerplexity",
+    }
+    assert acc.n_tokens == 16
+    np.testing.assert_allclose(
+        out["UnigramNormalizedLanguageCrossEntropy"],
+        out["LanguageCrossEntropy"] - out["PureUnigramCrossEntropy"],
+        rtol=1e-6,
+    )
+
+
+def test_history_roundtrip():
+    h = History()
+    h.record(1, {"loss": 3.0, "acc": 0.1})
+    h.record(2, {"loss": 2.5, "skipme": "not-a-float"})
+    assert h.latest("loss") == 2.5
+    assert h.series("loss") == [(1, 3.0), (2, 2.5)]
+    assert "skipme" not in h.rounds
+    h2 = History.from_dict(h.to_dict())
+    assert h2.series("loss") == h.series("loss")

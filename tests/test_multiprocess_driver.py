@@ -1,0 +1,52 @@
+"""Multiprocess driver: a real spawned node process serving a fed round over
+mp.Pipe + the shm bulk plane (reference topology: separate client-app
+processes, ``photon/client_app.py``). Kept tiny — each child compiles JAX."""
+
+import numpy as np
+import pytest
+
+from photon_tpu.checkpoint import FileStore, ServerCheckpointManager
+from photon_tpu.federation import MultiprocessDriver, ParamTransport, ServerApp
+from tests.test_federation import make_cfg
+
+pytestmark = pytest.mark.slow
+
+
+def test_multiprocess_fed_round(tmp_path):
+    cfg = make_cfg(tmp_path, n_rounds=1, n_total_clients=2, n_clients_per_round=2, local_steps=1)
+    cfg.photon.comm_stack.shm = False
+    cfg.photon.comm_stack.objstore = True  # cross-process plane via the store
+    driver = MultiprocessDriver(cfg, n_nodes=1, platform="cpu", n_cpu_devices=1)
+    store = FileStore(cfg.photon.save_path + "/store")
+    transport = ParamTransport("objstore", store=store)
+    app = ServerApp(cfg, driver, transport)
+    try:
+        history = app.run()
+        assert history.latest("server/round_time") is not None
+        assert history.latest("server/n_clients") == 2.0
+    finally:
+        driver.shutdown()
+
+
+def test_multiprocess_node_death_synthesizes_failure(tmp_path):
+    cfg = make_cfg(tmp_path, n_rounds=1)
+    driver = MultiprocessDriver(cfg, n_nodes=1, platform="cpu", n_cpu_devices=1, restart_dead=True)
+    try:
+        from photon_tpu.federation.messages import Query
+
+        # kill the node mid-flight: send a task, then terminate the process
+        nid = driver.node_ids()[0]
+        proc, _ = driver._nodes[nid]
+        mid = driver.send(nid, Query("ping"))
+        proc.terminate()
+        proc.join(timeout=10)
+        got_nid, got_mid, reply = driver.recv_any(timeout=30)
+        # either the ping's ack raced through before death, or a synthesized
+        # failure comes back; both must unblock the caller
+        assert got_mid == mid
+        # node was restarted either way
+        assert driver.node_ids() == [nid]
+        new_proc, _ = driver._nodes[nid]
+        assert new_proc.is_alive()
+    finally:
+        driver.shutdown()

@@ -1,0 +1,63 @@
+"""Native lib parity tests: ctypes bindings vs numpy/zlib oracles.
+Skipped when the lib isn't built (`make native`)."""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from photon_tpu import native
+
+
+requires_native = pytest.mark.skipif(not native.available(), reason="make native not built")
+
+
+@requires_native
+def test_gather_widen_u16():
+    rng = np.random.default_rng(0)
+    rows = [rng.integers(0, 1 << 16, 32, dtype=np.uint16) for _ in range(17)]
+    out = np.empty((17, 32), np.int32)
+    native.gather_rows(rows, out)
+    for i, r in enumerate(rows):
+        np.testing.assert_array_equal(out[i], r.astype(np.int32))
+
+
+@requires_native
+def test_gather_widen_u32():
+    rng = np.random.default_rng(1)
+    rows = [rng.integers(0, 1 << 18, 16, dtype=np.uint32) for _ in range(5)]
+    out = np.empty((5, 16), np.int32)
+    native.gather_rows(rows, out)
+    for i, r in enumerate(rows):
+        np.testing.assert_array_equal(out[i], r.astype(np.int32))
+
+
+@requires_native
+def test_par_memcpy_large():
+    rng = np.random.default_rng(2)
+    src = rng.integers(0, 255, 40 << 20, dtype=np.uint8)  # crosses thread threshold
+    dst = np.zeros_like(src)
+    native.parallel_memcpy(dst, src)
+    np.testing.assert_array_equal(dst, src)
+
+
+@requires_native
+def test_crc32_matches_zlib():
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 255, 100_000, dtype=np.uint8).tobytes()
+    assert native.crc32(data) == zlib.crc32(data)
+    assert native.crc32(data, seed=123) == zlib.crc32(data, 123)
+
+
+def test_fallback_paths_work(monkeypatch):
+    """With the lib hidden, every binding must fall back to numpy/zlib."""
+    monkeypatch.setattr(native, "_LIB", False)
+    rows = [np.arange(8, dtype=np.uint16), np.arange(8, 16, dtype=np.uint16)]
+    out = np.empty((2, 8), np.int32)
+    native.gather_rows(rows, out)
+    np.testing.assert_array_equal(out[1], np.arange(8, 16))
+    src = np.arange(100, dtype=np.uint8)
+    dst = np.zeros_like(src)
+    native.parallel_memcpy(dst, src)
+    np.testing.assert_array_equal(dst, src)
+    assert native.crc32(src.tobytes()) == zlib.crc32(src.tobytes())

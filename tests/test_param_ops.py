@@ -1,0 +1,183 @@
+"""param_ops tests: momenta payloads, personalization/randomization,
+embedding transplant, parameters_checker; plus trainer momenta round-trip,
+freezing, and a momenta-aggregating fed round."""
+
+import numpy as np
+import pytest
+
+from photon_tpu.codec import ParamsMetadata
+from photon_tpu.train.param_ops import (
+    extend_with_momenta,
+    has_momenta,
+    parameters_checker,
+    personalize_layers,
+    randomize_layers,
+    split_momenta,
+    transplant_embeddings,
+)
+
+
+def _payload(n=3, seed=0):
+    rng = np.random.default_rng(seed)
+    arrays = [rng.normal(size=(4, 2)).astype(np.float32) for _ in range(n)]
+    names = ["blocks/block/ln_1/scale", "blocks/block/wqkv/kernel", "wte/embedding"][:n]
+    return ParamsMetadata.from_ndarrays(names, arrays), arrays
+
+
+def test_momenta_roundtrip():
+    meta, params = _payload()
+    m1 = [np.full_like(p, 1.0) for p in params]
+    m2 = [np.full_like(p, 2.0) for p in params]
+    ext_meta, ext = extend_with_momenta(meta, params, m1, m2)
+    assert has_momenta(ext_meta) and not has_momenta(meta)
+    assert len(ext) == 9
+    base, p2, m1b, m2b = split_momenta(ext_meta, ext)
+    assert base.names == meta.names
+    np.testing.assert_array_equal(m1b[0], m1[0])
+    np.testing.assert_array_equal(m2b[2], m2[2])
+
+
+def test_momenta_zero_init():
+    meta, params = _payload()
+    _, ext = extend_with_momenta(meta, params)
+    assert all(np.all(a == 0) for a in ext[3:])
+
+
+def test_personalize_and_randomize():
+    meta, incoming = _payload(seed=1)
+    local = [a + 100 for a in incoming]
+    out = personalize_layers(meta, incoming, local, [r"wqkv"])
+    np.testing.assert_array_equal(out[1], local[1])
+    np.testing.assert_array_equal(out[0], incoming[0])
+
+    r1 = randomize_layers(meta, incoming, [r"wqkv"], seed=7)
+    r2 = randomize_layers(meta, incoming, [r"wqkv"], seed=7)
+    np.testing.assert_array_equal(r1[1], r2[1])  # deterministic
+    assert not np.allclose(r1[1], incoming[1])
+    np.testing.assert_array_equal(r1[0], incoming[0])  # untouched
+
+
+def test_transplant_embeddings():
+    meta, arrays = _payload()
+    donor_meta, donor = _payload(seed=9)
+    out = transplant_embeddings(meta, arrays, donor_meta, donor)
+    np.testing.assert_array_equal(out[2], donor[2])
+    np.testing.assert_array_equal(out[1], arrays[1])
+
+
+def test_parameters_checker():
+    _, a = _payload()
+    b = [x.copy() for x in a]
+    parameters_checker(a, b, expect_equal=True)
+    with pytest.raises(ValueError):
+        parameters_checker(a, b, expect_equal=False)
+    b[0] = b[0] + 1
+    parameters_checker(a, b, expect_equal=False)
+    with pytest.raises(ValueError):
+        parameters_checker(a, b, expect_equal=True)
+
+
+def test_trainer_momenta_roundtrip(tiny_trainer):
+    trainer, batch = tiny_trainer
+    trainer.fit([batch] * 3, duration_steps=3)
+    m1, m2 = trainer.get_momenta()
+    assert any(np.any(m != 0) for m in m1)
+    new_m1 = [np.full_like(m, 0.5) for m in m1]
+    new_m2 = [np.full_like(m, 0.25) for m in m2]
+    trainer.set_momenta(new_m1, new_m2)
+    got_m1, got_m2 = trainer.get_momenta()
+    np.testing.assert_allclose(got_m1[0], new_m1[0])
+    np.testing.assert_allclose(got_m2[0], new_m2[0])
+
+
+def test_freeze_patterns():
+    import jax
+    from photon_tpu.config.schema import (
+        Config, MeshConfig, ModelConfig, OptimizerConfig, SchedulerConfig, TrainConfig,
+    )
+    from photon_tpu.train.trainer import Trainer
+
+    cfg = Config(
+        model=ModelConfig(d_model=32, n_layers=2, n_heads=2, max_seq_len=16, vocab_size=64,
+                          attn_impl="xla", compute_dtype="float32"),
+        mesh=MeshConfig(),
+        optimizer=OptimizerConfig(name="adopt", lr=1e-2, freeze_patterns=[r"wte/embedding"]),
+        scheduler=SchedulerConfig(t_warmup=1, t_max=50),
+        train=TrainConfig(global_batch_size=4, device_microbatch_size=4),
+    )
+    trainer = Trainer(cfg, init_seed=0)
+    before_meta, before = trainer.get_parameters()
+    batch = np.random.default_rng(0).integers(0, 64, (4, 16), dtype=np.int64)
+    trainer.fit([batch] * 3, duration_steps=3)
+    _, after = trainer.get_parameters()
+    for name, b, a in zip(before_meta.names, before, after):
+        if "wte/embedding" in name:
+            np.testing.assert_array_equal(b, a)  # frozen
+        elif "wqkv" in name:
+            assert not np.allclose(b, a)  # trained
+
+
+def test_momenta_payload_survives_npz_and_objstore(tmp_path):
+    """Regression: npz round-trips must preserve [params|m1|m2] ORDER —
+    alphabetical npz key iteration would put '__momenta__' names first."""
+    from photon_tpu.checkpoint import FileStore, arrays_to_npz, npz_to_arrays
+    from photon_tpu.federation.transport import ParamTransport
+
+    meta, params = _payload()
+    ext_meta, ext = extend_with_momenta(meta, params)
+    m2, a2 = npz_to_arrays(arrays_to_npz(ext_meta, ext))
+    assert m2.names == ext_meta.names  # exact order, momenta last
+    base, _, _, _ = split_momenta(m2, a2)
+    assert base.names == meta.names
+
+    tr = ParamTransport("objstore", store=FileStore(tmp_path / "s"))
+    ptr = tr.put("momenta-payload", ext_meta, ext)
+    got_meta, got = tr.get(ptr)
+    assert got_meta.names == ext_meta.names
+    split_momenta(got_meta, got)  # must not raise
+
+
+def test_momenta_with_frozen_params():
+    """Regression: freeze_patterns leaves MaskedNode (no state) at frozen
+    slots; get/set_momenta must still align with the full param list."""
+    import numpy as np
+    from photon_tpu.config.schema import (
+        Config, MeshConfig, ModelConfig, OptimizerConfig, SchedulerConfig, TrainConfig,
+    )
+    from photon_tpu.train.trainer import Trainer
+
+    cfg = Config(
+        model=ModelConfig(d_model=32, n_layers=2, n_heads=2, max_seq_len=16, vocab_size=64,
+                          attn_impl="xla", compute_dtype="float32"),
+        mesh=MeshConfig(),
+        optimizer=OptimizerConfig(name="adopt", lr=1e-3, freeze_patterns=[r"wte/embedding"]),
+        scheduler=SchedulerConfig(t_warmup=1, t_max=50),
+        train=TrainConfig(global_batch_size=4, device_microbatch_size=4),
+    )
+    trainer = Trainer(cfg, init_seed=0)
+    batch = np.random.default_rng(0).integers(0, 64, (4, 16), dtype=np.int64)
+    trainer.fit([batch] * 2, duration_steps=2)
+    meta, params = trainer.get_parameters()
+    m1, m2 = trainer.get_momenta()
+    assert len(m1) == len(params) == len(m2)
+    frozen_idx = [i for i, n in enumerate(meta.names) if "wte/embedding" in n]
+    assert frozen_idx and all(np.all(m1[i] == 0) for i in frozen_idx)
+    trainer.set_momenta(m1, m2)  # must not raise
+    got_m1, _ = trainer.get_momenta()
+    trainable = [i for i in range(len(params)) if i not in frozen_idx]
+    np.testing.assert_allclose(got_m1[trainable[0]], m1[trainable[0]], rtol=1e-6)
+
+
+def test_fed_round_with_momenta_aggregation(tmp_path):
+    from tests.test_federation import make_cfg, make_app
+
+    cfg = make_cfg(tmp_path, n_rounds=2, aggregate_momenta=True)
+    app = make_app(cfg, tmp_path)
+    assert has_momenta(app.metadata)
+    history = app.run()
+    assert len(history.series("server/round_time")) == 2
+    # aggregated momenta circulate: the extended payload is non-zero after training
+    n = len(app.metadata.names) // 3
+    momenta_norms = [float(np.linalg.norm(a)) for a in app.strategy.current_parameters[n:]]
+    assert any(m > 0 for m in momenta_norms)
+    app.driver.shutdown()
