@@ -75,6 +75,11 @@ class ClientCheckpointManager:
         fully trained before a crash; re-use it instead of re-training."""
         return self.has(cid, target_step)
 
+    def load_params_only(self, cid: int, step: int) -> tuple[ParamsMetadata, list[np.ndarray]]:
+        """Read just ``params.npz`` — warm-start paths must not pay for the
+        (≈2× larger) optimizer blob they would immediately discard."""
+        return npz_to_arrays(self.store.get(f"{self._prefix(cid, step)}/params.npz"))
+
     def load(
         self, cid: int, step: int
     ) -> tuple[ParamsMetadata, list[np.ndarray], tuple[ParamsMetadata, list[np.ndarray]] | None, dict]:

@@ -201,6 +201,10 @@ class PhotonConfig:
     keep_checkpoints: int = 3
     resume_round: int | None = None  # negative = index from latest valid
     restore_run_uuid: str | None = None
+    # warm-start initial global params from another run's centralized
+    # checkpoint (reference: ``get_centralized_run_parameters``,
+    # ``init_utils.py:43-125``)
+    init_from_run: str | None = None
     comm_stack: CommStackConfig = field(default_factory=CommStackConfig)
     save_path: str = "/tmp/photon_tpu"
 

@@ -1,0 +1,65 @@
+"""Background batch prefetching.
+
+Reference analog: torch DataLoader worker processes (dataloader worker count
+tuning, ``llm_config_functions.py:903-968``). TPU-first the need is smaller —
+JAX dispatch is async, so the host loop is free while the device computes —
+but the host-side shard gather still serializes with step dispatch without a
+prefetcher. One daemon thread keeps a small queue of ready batches.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+import numpy as np
+
+
+class PrefetchIterator:
+    """Wrap a batch iterator; pull ``depth`` batches ahead on a thread.
+
+    Exceptions in the source iterator are re-raised at ``__next__``.
+    NOT resumable itself — resume state lives in the underlying loader, which
+    must not be advanced elsewhere while wrapped.
+    """
+
+    _DONE = object()
+
+    def __init__(self, source, depth: int = 2) -> None:
+        self.source = source
+        self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+        self._err: BaseException | None = None
+        self._stopped = False
+        self._thread = threading.Thread(target=self._fill, daemon=True)
+        self._thread.start()
+
+    def _fill(self) -> None:
+        try:
+            for batch in self.source:
+                if self._stopped:
+                    return
+                self._q.put(batch)
+        except BaseException as e:  # noqa: BLE001 — surfaced on the consumer side
+            self._err = e
+        self._q.put(self._DONE)
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        return self
+
+    def __next__(self) -> np.ndarray:
+        item = self._q.get()
+        if item is self._DONE:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        self._stopped = True
+        # drain so the producer unblocks if waiting on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass

@@ -62,8 +62,16 @@ def build_app(cfg: Config, n_nodes: int = 1, multiprocess: bool = False) -> Serv
     ckpt = ServerCheckpointManager(store, cfg.run_uuid) if cfg.photon.checkpoint else None
     from photon_tpu.metrics.history import History
 
+    initial = None
+    # warm start only applies to fresh runs: with resume_round set,
+    # try_resume would immediately overwrite it (and the source run's
+    # checkpoints may have been GC'd since)
+    if cfg.photon.init_from_run and cfg.photon.resume_round is None:
+        from photon_tpu.federation.server import centralized_warm_start
+
+        initial = centralized_warm_start(store, cfg.photon.init_from_run)
     history = History(make_wandb_run(None, cfg.run_uuid))
-    return ServerApp(cfg, driver, transport, ckpt_mgr=ckpt, history=history)
+    return ServerApp(cfg, driver, transport, ckpt_mgr=ckpt, history=history, initial_params=initial)
 
 
 def main(argv: list[str] | None = None) -> None:

@@ -51,6 +51,20 @@ class TooManyFailuresError(RuntimeError):
     """Round failure budget exceeded (reference: ``server_util.py:31``)."""
 
 
+def centralized_warm_start(store, run_uuid: str):
+    """Initial global params from another run's centralized checkpoint
+    (reference: ``get_centralized_run_parameters``, ``init_utils.py:43-125``).
+    Returns ``(metadata, arrays)`` of the latest centralized step."""
+    from photon_tpu.centralized import CENTRAL_CID
+    from photon_tpu.checkpoint.client import ClientCheckpointManager
+
+    mgr = ClientCheckpointManager(store, run_uuid)
+    steps = mgr.steps(CENTRAL_CID)
+    if not steps:
+        raise FileNotFoundError(f"run {run_uuid!r} has no centralized checkpoints")
+    return mgr.load_params_only(CENTRAL_CID, steps[-1])
+
+
 class ServerApp:
     def __init__(
         self,

@@ -1,0 +1,283 @@
+"""TCP control plane: the multi-host driver.
+
+Role parity with the reference's Flower gRPC SuperLink (server ⇄ node
+messaging across machines, ``server_util.py:144-202``; nodes dial in and the
+server waits for them, ``wait_for_nodes_to_connect`` ``server_util.py:35``).
+TPU-first there is no external broker: the server listens, node agents dial
+in and announce a node_id, and envelopes flow as length-prefixed pickles.
+
+Trust model: same as the reference's RecordSets (pickled configs between our
+own processes on a private network) — do NOT expose the port publicly.
+
+Bulk tensors do NOT travel on this socket (messages carry
+:class:`ParamPointer`s); pair with the objstore transport on shared/durable
+storage, or the DCN collective path.
+
+Usage::
+
+    # server host
+    driver = TcpServerDriver("0.0.0.0", 9777, expected_nodes=2)
+    driver.wait_for_nodes(timeout=300)
+    app = ServerApp(cfg, driver, transport, ...)
+
+    # each node host
+    python -m photon_tpu.federation.tcp --connect SERVER:9777 \
+        --node-id node0 --config run.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+import selectors
+import socket
+import struct
+import threading
+import time
+from typing import Any
+
+from photon_tpu.federation.driver import Driver
+from photon_tpu.federation.messages import Ack, Envelope, Query
+
+_LEN = struct.Struct("<Q")
+HELLO_KIND = "__hello__"
+
+
+class SocketConn:
+    """Length-prefixed pickle framing over a stream socket, Connection-like
+    (``send``/``recv``/``close``) so :meth:`NodeAgent.serve` runs unchanged."""
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # e.g. AF_UNIX socketpair in tests
+        self._rlock = threading.Lock()
+        self._wlock = threading.Lock()
+
+    def send(self, obj: Any) -> None:
+        data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        with self._wlock:
+            self.sock.sendall(_LEN.pack(len(data)) + data)
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise EOFError("peer closed")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def recv(self) -> Any:
+        with self._rlock:
+            (n,) = _LEN.unpack(self._read_exact(_LEN.size))
+            return pickle.loads(self._read_exact(n))
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class TcpServerDriver(Driver):
+    """Server side: accepts node registrations, routes envelopes."""
+
+    def __init__(self, host: str, port: int, expected_nodes: int) -> None:
+        self.expected_nodes = expected_nodes
+        self._nodes: dict[str, SocketConn] = {}
+        self._inflight: dict[str, list[int]] = {}
+        self._lock = threading.Lock()
+        self._mid = iter(range(1 << 62))
+        self._listener = socket.create_server((host, port))
+        self._accepting = True
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._listener.getsockname()[1]
+
+    def _accept_loop(self) -> None:
+        while self._accepting:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            conn = SocketConn(sock)
+            try:
+                hello = conn.recv()
+            except (EOFError, OSError, pickle.UnpicklingError):
+                conn.close()
+                continue
+            if not (isinstance(hello, dict) and hello.get("kind") == HELLO_KIND):
+                conn.close()
+                continue
+            node_id = str(hello["node_id"])
+            with self._lock:
+                old = self._nodes.get(node_id)
+                self._nodes[node_id] = conn
+                self._inflight.setdefault(node_id, [])
+            if old is not None:
+                old.close()  # reconnection replaces the stale socket
+
+    def wait_for_nodes(self, timeout: float = 300.0, poll: float = 0.2) -> None:
+        """Block until ``expected_nodes`` registered (reference:
+        ``wait_for_nodes_to_connect``, ``server_util.py:35``)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if len(self._nodes) >= self.expected_nodes:
+                    return
+            time.sleep(poll)
+        with self._lock:
+            have = sorted(self._nodes)
+        raise TimeoutError(f"only {len(have)}/{self.expected_nodes} nodes connected: {have}")
+
+    # -- Driver interface ------------------------------------------------
+    def node_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._nodes)
+
+    def send(self, node_id: str, msg: Any) -> int:
+        mid = next(self._mid)
+        with self._lock:
+            conn = self._nodes[node_id]
+            self._inflight[node_id].append(mid)
+        try:
+            conn.send(Envelope(msg, mid))
+        except OSError:
+            pass  # surfaced as a dead-node reply in recv_any
+        return mid
+
+    def recv_any(self, timeout: float | None = None) -> tuple[str, int, Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        sel = selectors.DefaultSelector()
+        try:
+            while True:
+                with self._lock:
+                    watched = {
+                        nid: conn
+                        for nid, conn in self._nodes.items()
+                        if self._inflight.get(nid)
+                    }
+                if not watched:
+                    raise TimeoutError("recv_any: nothing in flight")
+                for nid, conn in watched.items():
+                    try:
+                        sel.register(conn.sock, selectors.EVENT_READ, (nid, conn))
+                    except (ValueError, OSError, KeyError):
+                        # _accept_loop closed this socket during a node
+                        # reconnection between our snapshot and register —
+                        # skip it; the next loop iteration re-snapshots
+                        continue
+                left = None if deadline is None else max(0.0, deadline - time.monotonic())
+                ready = sel.select(timeout=left)
+                for key in list(sel.get_map().values()):
+                    sel.unregister(key.fileobj)
+                if not ready:
+                    raise TimeoutError("recv_any: timeout")
+                nid, conn = ready[0][0].data
+                try:
+                    env: Envelope = conn.recv()
+                except (EOFError, OSError, pickle.UnpicklingError):
+                    with self._lock:
+                        mids = self._inflight.get(nid, [])
+                        self._inflight[nid] = []
+                        if self._nodes.get(nid) is conn:
+                            del self._nodes[nid]
+                    conn.close()
+                    if mids:
+                        # dead node: synthesized failure, like MultiprocessDriver
+                        return nid, mids[0], Ack(ok=False, detail="node died", node_id=nid)
+                    continue
+                with self._lock:
+                    if env.msg_id in self._inflight.get(nid, []):
+                        self._inflight[nid].remove(env.msg_id)
+                return nid, env.msg_id, env.msg
+        finally:
+            sel.close()
+
+    def shutdown(self, ack_timeout: float = 5.0) -> None:
+        self._accepting = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            nodes = list(self._nodes.items())
+        for nid, conn in nodes:
+            try:
+                conn.send(Envelope(Query("shutdown"), next(self._mid)))
+            except OSError:
+                pass
+        # wait for each node's shutdown ack before closing: an immediate
+        # close can RST before the node's reply lands, making its agent
+        # treat clean shutdown as a server crash and redial for minutes
+        for nid, conn in nodes:
+            try:
+                conn.sock.settimeout(ack_timeout)
+                conn.recv()
+            except (OSError, EOFError, pickle.UnpicklingError):
+                pass
+            conn.close()
+        with self._lock:
+            self._nodes.clear()
+            self._inflight.clear()
+
+
+def run_node(server_addr: str, node_id: str, cfg_json: str, retries: int = 30) -> None:
+    """Node-side: dial the server and serve the agent loop (reference:
+    ``flower-client-app`` pointed at DRIVER_API_ADDRESS)."""
+    from photon_tpu.config.schema import Config
+    from photon_tpu.federation.node import NodeAgent
+    from photon_tpu.federation.transport import ParamTransport
+
+    host, _, port = server_addr.rpartition(":")
+    cfg = Config.from_json(cfg_json)
+
+    store = None
+    if cfg.photon.comm_stack.objstore:
+        from photon_tpu.checkpoint.store import FileStore
+
+        store = FileStore(cfg.photon.save_path + "/store")
+
+    def make_transport() -> ParamTransport:
+        mode = "objstore" if cfg.photon.comm_stack.objstore else "shm"
+        return ParamTransport(mode, store=store)
+
+    agent = NodeAgent(cfg, node_id, make_transport)
+    for attempt in range(retries):
+        try:
+            sock = socket.create_connection((host, int(port)), timeout=10)
+        except OSError:
+            time.sleep(min(2.0 * (attempt + 1), 10.0))
+            continue
+        conn = SocketConn(sock)
+        conn.send({"kind": HELLO_KIND, "node_id": node_id})
+        try:
+            agent.serve(conn)
+            return  # clean shutdown
+        except (EOFError, OSError):
+            continue  # server went away; retry dial
+        finally:
+            conn.close()
+    raise ConnectionError(f"could not reach server at {server_addr}")
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description="photon-tpu TCP node agent")
+    ap.add_argument("--connect", required=True, metavar="HOST:PORT")
+    ap.add_argument("--node-id", required=True)
+    ap.add_argument("--config", required=True, help="resolved config YAML")
+    args = ap.parse_args(argv)
+    from photon_tpu.config.schema import Config
+
+    cfg = Config.from_yaml(args.config)
+    run_node(args.connect, args.node_id, cfg.to_json())
+
+
+if __name__ == "__main__":
+    main()

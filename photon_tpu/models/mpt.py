@@ -34,6 +34,24 @@ def _dtype(name: str):
     return jnp.dtype(name)
 
 
+def _constrain_logits(logits: jax.Array) -> jax.Array:
+    """Pin the logits layout ([B,S,V]: batch over data+fsdp, seq over
+    sequence, vocab over tensor) when tracing under a mesh. Without the hint
+    SPMD can pick a batch-sharded logits layout and then involuntarily
+    rematerialize the whole tensor to reach the loss reduction."""
+    from photon_tpu.parallel.context import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None:
+        return logits
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from photon_tpu.parallel.sharding import _fit_spec
+
+    spec = _fit_spec(P(("data", "fsdp"), "sequence", "tensor"), logits.shape, mesh)
+    return jax.lax.with_sharding_constraint(logits, NamedSharding(mesh, spec))
+
+
 class FP32LayerNorm(nn.Module):
     """LayerNorm computed in fp32, scale-only when ``no_bias``."""
 
@@ -157,6 +175,7 @@ class MPTModel(nn.Module):
                 cfg.vocab_size, use_bias=False, dtype=compute,
                 param_dtype=_dtype(cfg.param_dtype), name="lm_head",
             )(x)
+        logits = _constrain_logits(logits)
         return logits.astype(_dtype(cfg.logits_dtype))
 
 

@@ -126,26 +126,37 @@ class Trainer:
         Returns summary metrics including the reference's KPI names
         (``client/fit_time``, BASELINE.md KPI table).
         """
-        it: Iterator[np.ndarray] = iter(batches)
+        import itertools
+
+        from photon_tpu.data.prefetch import PrefetchIterator
+
+        # prefetch EXACTLY duration_steps batches: the islice bound means the
+        # background thread never over-advances a resumable loader's state
+        it: Iterator[np.ndarray] = PrefetchIterator(
+            itertools.islice(iter(batches), duration_steps), depth=2
+        )
         t0 = time.monotonic()
         losses: list[float] = []
         last_metrics: dict[str, float] = {}
         tokens_seen = 0
-        for i in range(duration_steps):
-            try:
-                batch = next(it)
-            except StopIteration:
-                raise ValueError(
-                    f"batch stream exhausted at step {i}/{duration_steps}"
-                ) from None
-            tokens_seen += int(np.prod(batch.shape))
-            self.state, metrics = self._train_step(self.state, batch)
-            if (log_every and (i + 1) % log_every == 0) or i == duration_steps - 1:
-                metrics = {k: float(v) for k, v in metrics.items()}
-                losses.append(metrics["loss"])
-                last_metrics = metrics
-                if callback:
-                    callback(i, metrics)
+        try:
+            for i in range(duration_steps):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    raise ValueError(
+                        f"batch stream exhausted at step {i}/{duration_steps}"
+                    ) from None
+                tokens_seen += int(np.prod(batch.shape))
+                self.state, metrics = self._train_step(self.state, batch)
+                if (log_every and (i + 1) % log_every == 0) or i == duration_steps - 1:
+                    metrics = {k: float(v) for k, v in metrics.items()}
+                    losses.append(metrics["loss"])
+                    last_metrics = metrics
+                    if callback:
+                        callback(i, metrics)
+        finally:
+            it.close()
         jax.block_until_ready(self.state.step)
         dt = time.monotonic() - t0
         return {

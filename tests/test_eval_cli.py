@@ -1,0 +1,91 @@
+"""Eval CLI + centralized warm start: params flow from a centralized run into
+federated init and into the standalone evaluator."""
+
+import json
+
+import numpy as np
+import pytest
+
+from photon_tpu.centralized import run_centralized
+from photon_tpu.checkpoint import FileStore
+from photon_tpu.federation.server import centralized_warm_start
+from tests.test_centralized import _cfg
+
+
+@pytest.fixture(scope="module")
+def central_run(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("warm")
+    cfg = _cfg(tmp)
+    run_centralized(cfg, total_steps=2, dump_params=True)
+    return cfg, tmp
+
+
+def test_warm_start_loads_latest_central_params(central_run):
+    cfg, tmp = central_run
+    store = FileStore(tmp / "save" / "store")
+    meta, params = centralized_warm_start(store, cfg.run_uuid)
+    assert len(params) == len(meta.names)
+    assert all(np.isfinite(p).all() for p in params)
+    with pytest.raises(FileNotFoundError):
+        centralized_warm_start(store, "no-such-run")
+
+
+def test_eval_cli_npz_and_icl(central_run, tmp_path, capsys):
+    cfg, tmp = central_run
+    rows = [{"query": "abc", "choices": ["d", "z"], "gold": 0}] * 2
+    task_file = tmp_path / "toy.jsonl"
+    task_file.write_text("\n".join(json.dumps(r) for r in rows))
+
+    cfg_yaml = tmp_path / "cfg.yaml"
+    cfg.to_yaml(cfg_yaml)
+
+    from photon_tpu.eval.__main__ import main
+
+    main([
+        "--params-npz", str(tmp / "save" / "params_final.npz"),
+        "--config", str(cfg_yaml),
+        "--dataset", "",  # skip val loss (no client_* layout in central save)
+        "--icl-tasks", str(task_file),
+        "--tokenizer", "byte-fallback",
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "icl/toy/accuracy" in out
+    assert 0.0 <= out["icl/toy/accuracy"] <= 1.0
+
+
+def test_federated_init_from_run(central_run):
+    """photon.init_from_run warm-starts the federated globals from the
+    centralized checkpoint (reference: init_utils.py:43-125)."""
+    cfg, tmp = central_run
+    from photon_tpu.federated import build_app
+
+    fed_cfg = _cfg(tmp)  # same save_path → same store
+    fed_cfg.photon.checkpoint = False
+    fed_cfg.photon.init_from_run = cfg.run_uuid
+    fed_cfg.fl.n_total_clients = 2
+    fed_cfg.fl.n_clients_per_round = 2
+    app = build_app(fed_cfg)
+    try:
+        store = FileStore(tmp / "save" / "store")
+        meta, params = centralized_warm_start(store, cfg.run_uuid)
+        assert app.metadata.names == meta.names
+        for a, b in zip(app.strategy.current_parameters, params):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        app.driver.shutdown()
+
+
+def test_eval_cli_store_round_source(central_run, tmp_path, capsys):
+    """--store/--run without --round loads the centralized checkpoint."""
+    cfg, tmp = central_run
+    cfg_yaml = tmp_path / "cfg.yaml"
+    cfg.to_yaml(cfg_yaml)
+    from photon_tpu.eval.__main__ import main
+
+    main([
+        "--store", str(tmp / "save" / "store"),
+        "--run", cfg.run_uuid,
+        "--config", str(cfg_yaml),
+    ])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert out == "{}"  # no dataset/icl requested; params load path exercised

@@ -1,0 +1,86 @@
+"""TCP driver tests: framing, registration/wait, a full fed round over
+localhost sockets with node agents on threads, dead-node synthesis."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from photon_tpu.federation import NodeAgent, ParamTransport, ServerApp
+from photon_tpu.federation.messages import Query
+from photon_tpu.federation.tcp import HELLO_KIND, SocketConn, TcpServerDriver
+from tests.test_federation import make_cfg
+
+pytestmark = pytest.mark.slow
+
+
+def _thread_node(cfg, node_id, port):
+    """Node agent on a thread (cheaper than a process; same socket path)."""
+
+    def run():
+        agent = NodeAgent(cfg, node_id, lambda: ParamTransport("inline"))
+        sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        conn = SocketConn(sock)
+        conn.send({"kind": HELLO_KIND, "node_id": node_id})
+        try:
+            agent.serve(conn)
+        except (EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_socket_framing_roundtrip():
+    a, b = socket.socketpair()
+    ca, cb = SocketConn(a), SocketConn(b)
+    payload = {"x": np.arange(5), "s": "hi"}
+    ca.send(payload)
+    got = cb.recv()
+    np.testing.assert_array_equal(got["x"], payload["x"])
+    ca.close(); cb.close()
+
+
+def test_wait_for_nodes_times_out():
+    driver = TcpServerDriver("127.0.0.1", 0, expected_nodes=1)
+    with pytest.raises(TimeoutError):
+        driver.wait_for_nodes(timeout=0.3)
+    driver.shutdown()
+
+
+def test_tcp_fed_round(tmp_path):
+    cfg = make_cfg(tmp_path, n_rounds=1, n_total_clients=2, n_clients_per_round=2, local_steps=1)
+    driver = TcpServerDriver("127.0.0.1", 0, expected_nodes=2)
+    threads = [_thread_node(cfg, f"node{i}", driver.port) for i in range(2)]
+    driver.wait_for_nodes(timeout=30)
+    assert driver.node_ids() == ["node0", "node1"]
+
+    app = ServerApp(cfg, driver, ParamTransport("inline"))
+    try:
+        history = app.run()
+        assert history.latest("server/n_clients") == 2.0
+        assert history.latest("server/round_time") is not None
+    finally:
+        driver.shutdown()
+    for t in threads:
+        t.join(timeout=10)
+
+
+def test_tcp_dead_node_synthesizes_failure():
+    driver = TcpServerDriver("127.0.0.1", 0, expected_nodes=1)
+    # raw fake node that registers then vanishes mid-request
+    sock = socket.create_connection(("127.0.0.1", driver.port))
+    conn = SocketConn(sock)
+    conn.send({"kind": HELLO_KIND, "node_id": "ghost"})
+    driver.wait_for_nodes(timeout=10)
+    mid = driver.send("ghost", Query("ping"))
+    conn.close()
+    nid, got_mid, reply = driver.recv_any(timeout=10)
+    assert (nid, got_mid) == ("ghost", mid)
+    assert not reply.ok and "died" in reply.detail
+    assert "ghost" not in driver.node_ids()
+    driver.shutdown()
