@@ -50,6 +50,10 @@ class ModelConfig:
     expansion_ratio: int = 4
     no_bias: bool = True
     learned_pos_emb: bool = True
+    # ALiBi positional attention (MPT-family option; reference llm-foundry
+    # MPT exposes ``attn_config.alibi`` — the 125M recipe uses learned
+    # positions, but the family supports both)
+    alibi: bool = False
     tie_embeddings: bool = True
     attn_impl: str = AttnImpl.PALLAS.value
     # Numerics: params kept fp32, compute in bf16 (reference: amp_bf16 + FSDP
@@ -263,6 +267,8 @@ class Config:
             raise ValueError(f"bad client_count_scaling {self.fl.client_count_scaling}")
         if self.model.resid_pdrop != 0.0:
             raise ValueError("resid_pdrop > 0 is not implemented yet (dropout-free pretraining)")
+        if self.model.alibi and self.model.learned_pos_emb:
+            raise ValueError("alibi and learned_pos_emb are mutually exclusive")
         _ = self.model.d_head
         return self
 

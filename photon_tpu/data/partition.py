@@ -1,0 +1,67 @@
+"""Shard-level dataset partitioning: one PTS dataset → N client views.
+
+Role parity with the reference's IID stream partitioner
+(``photon/dataset/stream_partitioner.py:11-41``): split one converted
+dataset across clients WITHOUT copying bytes — each client view owns a
+subset of shards (streams are shard groups, matching mosaicml-streaming
+semantics). The conversion pipeline's per-client directories remain the
+primary layout; this covers the "I already converted one big dataset" path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from photon_tpu.data.shard_format import ShardedDataset
+
+
+class ShardSubsetView:
+    """A ShardedDataset restricted to a subset of its shards; duck-types the
+    loader-facing surface (len/shard_sizes/shard_offsets/batch/seq_len)."""
+
+    def __init__(self, ds: ShardedDataset, shard_indices: list[int]) -> None:
+        if not shard_indices:
+            raise ValueError("empty shard subset")
+        self.ds = ds
+        self.shard_indices = list(shard_indices)
+        self.seq_len = ds.seq_len
+        self.vocab_size = ds.vocab_size
+        self.shard_sizes = ds.shard_sizes[self.shard_indices]
+        self.shard_offsets = np.concatenate([[0], np.cumsum(self.shard_sizes)])
+
+    def __len__(self) -> int:
+        return int(self.shard_offsets[-1])
+
+    def _to_parent_index(self, i: int) -> int:
+        local_shard = int(np.searchsorted(self.shard_offsets, i, side="right") - 1)
+        row = i - int(self.shard_offsets[local_shard])
+        parent_shard = self.shard_indices[local_shard]
+        return int(self.ds.shard_offsets[parent_shard]) + row
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        if not 0 <= i < len(self):
+            raise IndexError(i)
+        return self.ds[self._to_parent_index(i)]
+
+    def batch(self, idxs: np.ndarray) -> np.ndarray:
+        parent = np.asarray([self._to_parent_index(int(i)) for i in idxs], np.int64)
+        return self.ds.batch(parent)
+
+
+def partition_shards(
+    ds: ShardedDataset, n_clients: int, mode: str = "round_robin"
+) -> list[ShardSubsetView]:
+    """Assign shards to clients IID (``round_robin``, the reference's IID
+    partitioner) or ``contiguous`` (ordered ranges)."""
+    n_shards = len(ds.shard_sizes)
+    if n_shards < n_clients:
+        raise ValueError(f"{n_shards} shards cannot cover {n_clients} clients; "
+                         "re-convert with smaller samples_per_shard")
+    if mode == "round_robin":
+        groups = [list(range(c, n_shards, n_clients)) for c in range(n_clients)]
+    elif mode == "contiguous":
+        bounds = np.linspace(0, n_shards, n_clients + 1).astype(int)
+        groups = [list(range(bounds[c], bounds[c + 1])) for c in range(n_clients)]
+    else:
+        raise ValueError(f"unknown partition mode {mode!r}")
+    return [ShardSubsetView(ds, g) for g in groups]

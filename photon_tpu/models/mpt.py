@@ -98,7 +98,7 @@ class MPTBlock(nn.Module):
         shape = (b, s, cfg.n_heads, cfg.d_head)
         attn_out = multihead_attention(
             q.reshape(shape), k.reshape(shape), v.reshape(shape),
-            impl=cfg.attn_impl, causal=True,
+            impl=cfg.attn_impl, causal=True, alibi=cfg.alibi,
         )
         attn_out = attn_out.reshape(b, s, cfg.d_model)
         x = x + dense(cfg.d_model, "out_proj", resid_std)(attn_out)
@@ -141,7 +141,8 @@ class MPTModel(nn.Module):
             name="wte",
         )
         x = wte(tokens)
-        if cfg.learned_pos_emb:
+        # with ALiBi the position signal lives in the attention bias; no wpe
+        if cfg.learned_pos_emb and not cfg.alibi:
             wpe = self.param(
                 "wpe",
                 nn.initializers.normal(stddev=cfg.emb_init_std),

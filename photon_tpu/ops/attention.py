@@ -18,16 +18,38 @@ import jax
 import jax.numpy as jnp
 
 
+def alibi_slopes(n_heads: int) -> jax.Array:
+    """Standard ALiBi head slopes ``2^(-8i/H)`` for i = 1..H (MPT uses the
+    power-of-two geometric schedule; non-power-of-two head counts use the
+    same closed form, matching llm-foundry's ``gen_slopes``)."""
+    import math
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start**i) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        slopes = pow2_slopes(n_heads)
+    else:
+        closest = 2 ** math.floor(math.log2(n_heads))
+        slopes = pow2_slopes(closest)
+        extra = pow2_slopes(2 * closest)
+        slopes += extra[0::2][: n_heads - closest]
+    return jnp.asarray(slopes, jnp.float32)
+
+
 def xla_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     *,
     causal: bool = True,
+    alibi: bool = False,
 ) -> jax.Array:
     """Plain softmax attention; XLA fuses mask+softmax into the matmuls.
 
-    Numerically the oracle for the Pallas kernel's parity tests.
+    Numerically the oracle for the Pallas kernel's parity tests. ``alibi``
+    adds the per-head linear distance bias ``-slope_h * (q_pos - k_pos)``.
     """
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
@@ -35,10 +57,13 @@ def xla_attention(
     # [b, h, s_q, s_k] in fp32 for a stable softmax
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     scores = scores * scale
+    q_pos = jnp.arange(s_q)[:, None] + (s_k - s_q)
+    k_pos = jnp.arange(s_k)[None, :]
+    if alibi:
+        dist = (q_pos - k_pos).astype(jnp.float32)  # >= 0 on the causal part
+        scores = scores - alibi_slopes(h)[None, :, None, None] * dist[None, None]
     if causal:
         # offset supports s_q != s_k (e.g. decode); here typically equal
-        q_pos = jnp.arange(s_q)[:, None] + (s_k - s_q)
-        k_pos = jnp.arange(s_k)[None, :]
         mask = q_pos >= k_pos
         scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
@@ -54,27 +79,31 @@ def multihead_attention(
     *,
     impl: str = "pallas",
     causal: bool = True,
+    alibi: bool = False,
 ) -> jax.Array:
     """Dispatch on ``impl`` ∈ {pallas, xla, ring}. Falls back to XLA off-TPU;
     ``ring`` = context parallelism over the ambient mesh's ``sequence`` axis
     (``photon_tpu/ops/ring_attention.py``), degrading to pallas/xla when the
-    axis is trivial."""
+    axis is trivial. ALiBi currently runs on the XLA/ring paths (the Pallas
+    kernel dispatches to XLA when ``alibi`` until the bias lands in-kernel)."""
     if impl == "ring":
         from photon_tpu.ops.flash_attention import pallas_supported
         from photon_tpu.ops.ring_attention import ring_attention
         from photon_tpu.parallel.context import current_mesh
 
         mesh = current_mesh()
-        inner = "pallas" if pallas_supported(q) else "xla"
+        inner = "pallas" if (pallas_supported(q) and not alibi) else "xla"
         if mesh is not None and mesh.shape.get("sequence", 1) > 1:
-            return ring_attention(q, k, v, mesh, causal=causal, impl=inner)
+            return ring_attention(q, k, v, mesh, causal=causal, impl=inner, alibi=alibi)
         impl = inner
-    if impl == "pallas":
+    if impl == "pallas" and not alibi:
         from photon_tpu.ops.flash_attention import flash_attention, pallas_supported
 
         if pallas_supported(q):
             return flash_attention(q, k, v, causal=causal)
         impl = "xla"
+    elif impl == "pallas":
+        impl = "xla"
     if impl != "xla":
         raise ValueError(f"unknown attention impl {impl!r}")
-    return xla_attention(q, k, v, causal=causal)
+    return xla_attention(q, k, v, causal=causal, alibi=alibi)

@@ -48,20 +48,32 @@ def _merge_partials(o1, lse1, o2, lse2):
     return o.astype(o1.dtype), lse
 
 
-def xla_chunk_attention(q, k, v, *, q_start: int, k_start: int, causal: bool, scale: float | None = None):
+def xla_chunk_attention(q, k, v, *, q_start: int, k_start: int, causal: bool,
+                        scale: float | None = None, alibi: bool = False):
     """Per-chunk attention with global-position causal mask; returns
     ``(o, lse)`` with fully-masked rows as ``(0, NEG_INF)``.
 
     Shapes: q [b, sq, h, d], k/v [b, sk, h, d]; offsets are the chunks'
     global sequence starts (static per ring step). ``scale`` overrides
     ``1/sqrt(d)`` (the flash backward recompute passes the unpadded scale).
+    ``alibi`` adds the distance bias using GLOBAL positions, so the merged
+    ring result equals full ALiBi attention exactly.
     """
     d = q.shape[-1]
     scale = (1.0 / (d**0.5)) if scale is None else scale
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    q_pos = jnp.arange(q.shape[1])[:, None] + q_start
+    k_pos = jnp.arange(k.shape[1])[None, :] + k_start
+    if alibi is not None and alibi is not False:
+        # ``alibi`` is the per-head slopes array for THESE heads ([h_local] —
+        # under TP the caller passes the local slice, never recompute from
+        # the local head count) or True for all-heads contexts
+        from photon_tpu.ops.attention import alibi_slopes
+
+        slopes = alibi_slopes(q.shape[2]) if alibi is True else jnp.asarray(alibi)
+        dist = (q_pos - k_pos).astype(jnp.float32)
+        s = s - slopes[None, :, None, None] * dist[None, None]
     if causal:
-        q_pos = jnp.arange(q.shape[1])[:, None] + q_start
-        k_pos = jnp.arange(k.shape[1])[None, :] + k_start
         s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     masked_all = m <= NEG_INF / 2
@@ -74,12 +86,14 @@ def xla_chunk_attention(q, k, v, *, q_start: int, k_start: int, causal: bool, sc
     return o, jnp.transpose(lse, (0, 2, 1))
 
 
-def _chunk_attn(q, k, v, *, q_start, k_start, causal, impl):
-    if impl == "pallas":
+def _chunk_attn(q, k, v, *, q_start, k_start, causal, impl, alibi=None):
+    if impl == "pallas" and alibi is None:
         from photon_tpu.ops.flash_attention import flash_attention_with_lse
 
         return flash_attention_with_lse(q, k, v, causal=causal, q_start=q_start, k_start=k_start)
-    return xla_chunk_attention(q, k, v, q_start=q_start, k_start=k_start, causal=causal)
+    return xla_chunk_attention(
+        q, k, v, q_start=q_start, k_start=k_start, causal=causal, alibi=alibi
+    )
 
 
 def ring_attention(
@@ -93,29 +107,39 @@ def ring_attention(
     axis_name: str = "sequence",
     batch_axes: tuple[str, ...] = ("data", "fsdp"),
     head_axis: str = "tensor",
+    alibi: bool = False,
 ) -> jax.Array:
     """Exact attention over sequence-sharded ``[b, s, h, d]`` inputs.
 
     ``s`` is the GLOBAL sequence length; inside the shard_map each device
     sees ``s / n_ring`` rows. Heads stay sharded on the ``tensor`` axis (the
     spec names it, so TP composes — no gather at the shard_map boundary).
+    ``alibi`` applies the distance bias with GLOBAL positions; slopes travel
+    as a sharded input so each head shard uses its own slice.
     """
+    from photon_tpu.ops.attention import alibi_slopes as _make_slopes
+
     n_ring = mesh.shape[axis_name]
+    h = q.shape[2]
     if n_ring == 1:
-        return _chunk_attn(q, k, v, q_start=0, k_start=0, causal=causal, impl=impl)[0]
+        return _chunk_attn(
+            q, k, v, q_start=0, k_start=0, causal=causal, impl=impl,
+            alibi=_make_slopes(h) if alibi else None,
+        )[0]
     s_global = q.shape[1]
     if s_global % n_ring:
         raise ValueError(f"seq {s_global} not divisible by ring size {n_ring}")
     s_local = s_global // n_ring
-    h = q.shape[2]
     h_axis = head_axis if head_axis in mesh.shape and h % mesh.shape[head_axis] == 0 else None
     spec = P(batch_axes, axis_name, h_axis, None)
+    slopes_full = _make_slopes(h) if alibi else jnp.zeros((h,), jnp.float32)
+    slopes_spec = P(h_axis)
 
     # one branch per (my_index, ring_step) is unrolled with STATIC offsets;
     # lax.switch over axis_index picks the right branch at run time. n_ring is
     # small (≤ #chips on the axis) so the unroll is cheap and each branch's
     # inner kernel gets fully static masks.
-    def local(q_l, k_l, v_l):
+    def local(q_l, k_l, v_l, slopes_l):
         idx = jax.lax.axis_index(axis_name)
         perm = [(i, (i + 1) % n_ring) for i in range(n_ring)]
 
@@ -127,13 +151,14 @@ def ring_attention(
                 # Outputs are built FROM the inputs (×0) so they carry the
                 # same varying-axes (vma) as the kernel branch — lax.switch
                 # requires all branches to agree.
-                zero = q_l * 0 + k_c[:, :1] * 0 + v_c[:, :1] * 0
+                zero = q_l * 0 + k_c[:, :1] * 0 + v_c[:, :1] * 0 + slopes_l[None, None, :, None] * 0
                 lse = zero.sum(axis=-1).astype(jnp.float32) + NEG_INF
-                return zero, lse
+                return zero.astype(q_l.dtype), lse
             return _chunk_attn(
                 q_l, k_c, v_c,
                 q_start=my_idx * s_local, k_start=src * s_local,
                 causal=causal, impl=impl,
+                alibi=slopes_l if alibi else None,
             )
 
         o = jnp.zeros_like(q_l)
@@ -150,4 +175,6 @@ def ring_attention(
                 v_c = jax.lax.ppermute(v_c, axis_name, perm)
         return o
 
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+    return shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec, slopes_spec), out_specs=spec
+    )(q, k, v, slopes_full)
