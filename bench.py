@@ -1,44 +1,236 @@
 """Headline benchmark: MPT-125M training throughput on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+Resilience design (round-1 postmortem: one backend hiccup = rc=1 and a wasted
+round): the default invocation is a SUPERVISOR that never imports jax itself.
+It runs the real bench as a subprocess with a hard timeout, retries TPU with
+backoff (the relay is known-flaky), then falls back to a CPU smoke run, and
+emits a structured failure JSON if everything fails — never a bare traceback.
 
 The recipe matches the reference's 125M training config
 (conf/llm_config/mpt-125m.yaml:18-92): d768/12L/12H, seq 2048, vocab 50368,
 bf16 compute, ADOPT lr 6e-4, grad clip 1.0, flash attention (Pallas here).
+
+On TPU the run also executes a Pallas-vs-XLA kernel parity check (fwd + bwd +
+the lse ring inner path) at the 125M attention shape and writes
+KERNEL_PARITY.json next to this file; `kernel_parity_ok` lands in the JSON
+line. MFU is reported against the detected chip's bf16 peak
+(utils/profiling.py).
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
 denominator is a derived A100 estimate for the same recipe: ~0.97 GFLOP/token
 (6N non-embedding + attention + tied lm_head) at 35% MFU of 312 TFLOPs bf16
 ≈ 110k tokens/sec/GPU. >1.0 means faster than that estimate per chip.
 
-Env knobs: PHOTON_BENCH_STEPS (timed steps, default 8),
+Env knobs: PHOTON_BENCH_STEPS (timed steps, default 16),
 PHOTON_BENCH_MICROBATCH (rows per scan step, default 8),
-PHOTON_BENCH_GBS (global batch rows, default 16).
+PHOTON_BENCH_GBS (global batch rows, default 16),
+PHOTON_BENCH_PLATFORM (skip straight to tpu|cpu),
+PHOTON_BENCH_SKIP_PARITY=1 (skip the kernel parity check).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import pathlib
+import subprocess
 import sys
 import time
 
-import numpy as np
-
 A100_EST_TOKENS_PER_SEC = 110_000.0
+METRIC = "mpt125m_train_tokens_per_sec_per_chip"
+HERE = pathlib.Path(__file__).parent
 
 
 def log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def main() -> None:
+def emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor (default entry; imports no jax)
+# ---------------------------------------------------------------------------
+
+
+def _scan_result(stdout: str) -> dict | None:
+    """Last JSON line carrying the headline metric, if any."""
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                cand = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if cand.get("metric") == METRIC:
+                return cand
+    return None
+
+
+def supervise() -> int:
+    forced = os.environ.get("PHOTON_BENCH_PLATFORM", "")
+    if forced:
+        attempts = [(forced, 1800)]
+    else:
+        # first TPU attempt gets the cold-compile budget (parity kernels +
+        # 125M train step with an empty .jax_cache); later attempts are warm
+        attempts = [("tpu", 1500), ("tpu", 900), ("cpu", 900)]
+    last_tail = ""
+    i = 0
+    prev_platform = None
+    while i < len(attempts):
+        platform, tmo = attempts[i]
+        if i and platform == prev_platform:
+            # backoff exists to let the flaky relay recover; a platform
+            # switch (fallback) doesn't need it
+            delay = 15 * i
+            log(f"retrying in {delay}s (attempt {i + 1}/{len(attempts)}, platform={platform})")
+            time.sleep(delay)
+        prev_platform = platform
+        cmd = [sys.executable, str(pathlib.Path(__file__).resolve()), "--run", "--platform", platform]
+        log(f"spawning: {' '.join(cmd[1:])} (timeout {tmo}s)")
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=tmo, cwd=str(HERE)
+            )
+        except subprocess.TimeoutExpired as e:
+            def _text(x):
+                return x.decode(errors="replace") if isinstance(x, bytes) else (x or "")
+
+            # the child may have emitted a valid result and then hung in
+            # teardown (the documented relay failure mode) — salvage it
+            salvaged = _scan_result(_text(e.stdout))
+            if salvaged is not None:
+                log(f"attempt {i + 1} ({platform}): child hung in teardown after "
+                    "emitting a valid result — using it")
+                emit(salvaged)
+                return 0
+            stderr_tail = " | ".join(_text(e.stderr).strip().splitlines()[-5:])
+            last_tail = f"attempt {i + 1} ({platform}): timed out after {tmo}s; {stderr_tail}"
+            log(last_tail)
+            if platform == "tpu":
+                # a SIGKILLed TPU client mid-claim wedges the relay, so
+                # further TPU attempts would hang their full timeout too —
+                # skip straight to the CPU fallback
+                log("TPU attempt hung; skipping remaining TPU attempts (relay likely wedged)")
+                i = next((j for j, (p, _) in enumerate(attempts) if j > i and p != "tpu"),
+                         len(attempts))
+            else:
+                i += 1
+            continue
+        for line in proc.stderr.splitlines():
+            log(f"  {line}")
+        result = _scan_result(proc.stdout)
+        if result is not None and proc.returncode == 0:
+            emit(result)
+            return 0
+        last_tail = (
+            f"attempt {i + 1} ({platform}): rc={proc.returncode}; "
+            + " | ".join(proc.stderr.strip().splitlines()[-3:])
+        )
+        log(last_tail)
+        i += 1
+    emit(
+        {
+            "metric": METRIC,
+            "value": 0.0,
+            "unit": "tokens/sec",
+            "vs_baseline": 0.0,
+            "error": f"all bench attempts failed; last: {last_tail}"[:800],
+        }
+    )
+    return 0  # structured failure, not a crash
+
+
+# ---------------------------------------------------------------------------
+# Kernel parity (runs on TPU inside the bench subprocess)
+# ---------------------------------------------------------------------------
+
+
+def kernel_parity() -> dict:
+    """Pallas-vs-XLA parity at the 125M attention shape (bf16, seq 2048,
+    d_head 64): forward, backward, and the lse-returning ring inner path.
+    Replaces the evidence role of CUDA flash-attn's own test suite
+    (reference README.md:96-100)."""
     import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.ops.attention import xla_attention
+    from photon_tpu.ops.flash_attention import flash_attention, flash_attention_with_lse
+    from photon_tpu.ops.ring_attention import xla_chunk_attention
+
+    b, s, h, d = 2, 2048, 12, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, s, h, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, s, h, d), jnp.bfloat16)
+    w = jax.random.normal(ks[3], (b, s, h, d), jnp.float32)  # cotangent weights
+
+    def rel(a, ref):
+        a = jnp.asarray(a, jnp.float32)
+        ref = jnp.asarray(ref, jnp.float32)
+        return float(jnp.linalg.norm(a - ref) / (jnp.linalg.norm(ref) + 1e-12))
+
+    res: dict = {}
+
+    # forward
+    o_p = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+    o_x = jax.jit(lambda q, k, v: xla_attention(q, k, v, causal=True))(q, k, v)
+    res["fwd_rel_err"] = rel(o_p, o_x)
+
+    # backward (weighted-sum loss so every output element gets a cotangent)
+    def loss(fn):
+        return jax.jit(jax.grad(
+            lambda q, k, v: (fn(q, k, v).astype(jnp.float32) * w).sum(), argnums=(0, 1, 2)
+        ))
+
+    gp = loss(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+    gx = loss(lambda q, k, v: xla_attention(q, k, v, causal=True))(q, k, v)
+    for name, a, ref in zip(("dq", "dk", "dv"), gp, gx):
+        res[f"bwd_{name}_rel_err"] = rel(a, ref)
+
+    # lse path (ring inner kernel) vs the XLA chunk oracle, on the diagonal
+    # chunk (exercises masking + finite lse together)
+    o_l, lse_l = jax.jit(
+        lambda q, k, v: flash_attention_with_lse(q, k, v, causal=True, q_start=0, k_start=0)
+    )(q, k, v)
+    o_r, lse_r = jax.jit(
+        lambda q, k, v: xla_chunk_attention(q, k, v, q_start=0, k_start=0, causal=True)
+    )(q, k, v)
+    res["lse_fwd_rel_err"] = rel(o_l, o_r)
+    res["lse_rel_err"] = rel(lse_l, lse_r)
+
+    tol = {"fwd": 2e-2, "bwd": 4e-2, "lse_fwd": 2e-2, "lse": 1e-2}
+    res["ok"] = all(
+        err < tol["bwd" if key.startswith("bwd") else
+                  "lse" if key == "lse_rel_err" else
+                  "lse_fwd" if key == "lse_fwd_rel_err" else "fwd"]
+        for key, err in res.items()
+        if key.endswith("rel_err")
+    )
+    res["shape"] = {"batch": b, "seq": s, "heads": h, "d_head": d, "dtype": "bfloat16"}
+    return res
+
+
+# ---------------------------------------------------------------------------
+# The actual bench (child process)
+# ---------------------------------------------------------------------------
+
+
+def run(platform: str) -> None:
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
 
     # persistent compile cache: the driver re-runs this every round — only
     # round 1 pays the full compile
-    cache_dir = pathlib.Path(__file__).parent / ".jax_cache"
+    cache_dir = HERE / ".jax_cache"
     cache_dir.mkdir(exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", str(cache_dir))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -46,11 +238,26 @@ def main() -> None:
     from photon_tpu.config.schema import Config
     from photon_tpu.parallel.mesh import single_device_mesh
     from photon_tpu.train.trainer import Trainer
+    from photon_tpu.utils.profiling import (
+        A100_PEAK_FLOPS,
+        model_flops_per_token,
+        peak_flops_for_device_kind,
+    )
 
     t_boot = time.perf_counter()
-    platform = jax.devices()[0].platform
-    log(f"backend up in {time.perf_counter() - t_boot:.1f}s: {jax.devices()[0]}")
-    on_tpu = platform == "tpu"
+    dev = jax.devices()[0]
+    log(f"backend up in {time.perf_counter() - t_boot:.1f}s: {dev} kind={dev.device_kind}")
+    on_tpu = dev.platform == "tpu"
+    if platform == "tpu" and not on_tpu:
+        raise RuntimeError(f"wanted tpu, got {dev.platform}")
+
+    parity = None
+    if on_tpu and os.environ.get("PHOTON_BENCH_SKIP_PARITY") != "1":
+        t0 = time.perf_counter()
+        parity = kernel_parity()
+        (HERE / "KERNEL_PARITY.json").write_text(json.dumps(parity, indent=2))
+        log(f"kernel parity in {time.perf_counter() - t0:.1f}s: "
+            f"ok={parity['ok']} {({k: round(v, 5) for k, v in parity.items() if k.endswith('rel_err')})}")
 
     cfg = Config()
     cfg.model.attn_impl = "pallas" if on_tpu else "xla"
@@ -69,6 +276,8 @@ def main() -> None:
     trainer = Trainer(cfg, mesh=single_device_mesh())
     log(f"trainer built in {time.perf_counter() - t0:.1f}s (n_micro={trainer._n_micro})")
 
+    import numpy as np
+
     rng = np.random.default_rng(0)
 
     def batch():
@@ -81,7 +290,7 @@ def main() -> None:
     trainer.state, _ = trainer._train_step(trainer.state, batch())
     jax.block_until_ready(trainer.state.step)
 
-    n_steps = int(os.environ.get("PHOTON_BENCH_STEPS", "8" if on_tpu else "2"))
+    n_steps = max(1, int(os.environ.get("PHOTON_BENCH_STEPS", "16" if on_tpu else "2")))
     t0 = time.perf_counter()
     for _ in range(n_steps):
         trainer.state, m = trainer._train_step(trainer.state, batch())
@@ -89,18 +298,51 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     toks_per_sec = n_steps * gbs * seq / dt
-    log(f"{n_steps} steps in {dt:.2f}s, loss={float(m['loss']):.3f}")
-    print(
-        json.dumps(
-            {
-                "metric": "mpt125m_train_tokens_per_sec_per_chip",
-                "value": round(toks_per_sec, 1),
-                "unit": "tokens/sec",
-                "vs_baseline": round(toks_per_sec / A100_EST_TOKENS_PER_SEC, 4),
-            }
-        )
-    )
+    flops_per_tok = model_flops_per_token(cfg.model)
+    peak = peak_flops_for_device_kind(dev.device_kind) if on_tpu else A100_PEAK_FLOPS
+    mfu = toks_per_sec * flops_per_tok / peak
+    log(f"{n_steps} steps in {dt:.2f}s, loss={float(m['loss']):.3f}, "
+        f"mfu={mfu:.3f} (peak {peak / 1e12:.0f} TF)")
+    out = {
+        "metric": METRIC,
+        "value": round(toks_per_sec, 1),
+        "unit": "tokens/sec",
+        # the A100-derived bar only applies to the real recipe on TPU; a
+        # CPU smoke run is a different model (2 layers, seq 256), so its
+        # vs_baseline is pinned to 0 and the degradation is explicit
+        "vs_baseline": round(toks_per_sec / A100_EST_TOKENS_PER_SEC, 4) if on_tpu else 0.0,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "mfu": round(mfu, 4),
+        "peak_tflops_assumed": round(peak / 1e12, 1),
+        "steps": n_steps,
+        "microbatch": micro,
+        "global_batch": gbs,
+    }
+    if not on_tpu:
+        out["degraded"] = "cpu-smoke-fallback (2-layer seq-256 model, not the 125M recipe)"
+    if parity is not None:
+        out["kernel_parity_ok"] = parity["ok"]
+    emit(out)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--run", action="store_true", help="run the bench in-process (child mode)")
+    ap.add_argument("--platform", default="tpu", choices=["tpu", "cpu"])
+    ap.add_argument("--kernel-parity", action="store_true",
+                    help="run only the Pallas-vs-XLA parity check and print its JSON")
+    args = ap.parse_args()
+    if args.kernel_parity:
+        parity = kernel_parity()
+        (HERE / "KERNEL_PARITY.json").write_text(json.dumps(parity, indent=2))
+        emit(parity)
+        return 0 if parity["ok"] else 1
+    if args.run:
+        run(args.platform)
+        return 0
+    return supervise()
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

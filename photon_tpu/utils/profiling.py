@@ -24,6 +24,26 @@ TPU_V5E_PEAK_FLOPS = 197e12  # bf16
 TPU_V4_PEAK_FLOPS = 275e12
 A100_PEAK_FLOPS = 312e12
 
+# bf16 peak FLOPs by device_kind substring (first match wins; most-specific
+# first). Used to turn tokens/sec into MFU for whatever chip the bench lands
+# on.
+PEAK_FLOPS_BY_DEVICE_KIND: list[tuple[str, float]] = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", TPU_V5E_PEAK_FLOPS),
+    ("v5 lite", TPU_V5E_PEAK_FLOPS),
+    ("v5litepod", TPU_V5E_PEAK_FLOPS),
+    ("v5", 459e12),  # bare "TPU v5" (no lite marker) = v5p
+    ("v4", TPU_V4_PEAK_FLOPS),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def peak_flops_for_device_kind(kind: str, default: float = TPU_V5E_PEAK_FLOPS) -> float:
+    kind = kind.lower()
+    return next((p for sub, p in PEAK_FLOPS_BY_DEVICE_KIND if sub in kind), default)
+
 
 @contextlib.contextmanager
 def trace(log_dir: str, enabled: bool = True) -> Iterator[None]:
