@@ -80,6 +80,7 @@ def run_centralized(
     )
 
     def run_eval(step: int) -> dict[str, float]:
+        eval_loader.reset()  # every eval scores the same fixed window
         batches = [next(eval_loader) for _ in range(cfg.train.eval_batches)]
         m = trainer.evaluate(batches)
         history.record(step, m)
@@ -106,21 +107,32 @@ def run_centralized(
 
     save_every = checkpoint_interval_steps or max(total_steps // 10, 1)
     log_every = cfg.train.log_interval
+
+    def _to_boundary(every: int) -> int:
+        return every - trainer.step % every  # steps until the next multiple
+
     while trainer.step < total_steps:
-        chunk = min(save_every - (trainer.step % save_every) or save_every, total_steps - trainer.step)
+        # stop each fit chunk at whichever boundary comes first — checkpoint
+        # OR eval — so mid-run eval fires at its configured interval even when
+        # it isn't aligned with save_every (round-2 ADVICE finding: eval only
+        # fired when a save boundary happened to divide eval_interval)
+        chunk = min(_to_boundary(save_every), total_steps - trainer.step)
+        if eval_interval_steps:
+            chunk = min(chunk, _to_boundary(eval_interval_steps))
         t0 = time.monotonic()
         metrics = trainer.fit(train_loader, chunk, log_every=log_every)
         metrics["train/steps_per_sec"] = chunk / (time.monotonic() - t0)
         history.record(trainer.step, metrics)
         print(json.dumps({"step": trainer.step, "loss": round(metrics.get("loss", float("nan")), 4),
                           "tokens_per_sec": round(metrics.get("client/tokens_per_sec", 0.0), 1)}))
-        if cfg.photon.checkpoint:
+        at_save = trainer.step % save_every == 0 or trainer.step >= total_steps
+        if cfg.photon.checkpoint and at_save:
             pm, pa = trainer.get_parameters()
             om, oa = trainer.get_opt_state_arrays()
             ckpt.save(CENTRAL_CID, trainer.step, pm, pa, om, oa,
                       extra_state={"loader": train_loader.state_dict()})
             ckpt.cleanup(CENTRAL_CID, keep=cfg.photon.keep_checkpoints)
-        if eval_interval_steps and trainer.step % eval_interval_steps == 0:
+        if eval_interval_steps and trainer.step % eval_interval_steps == 0 and trainer.step < total_steps:
             run_eval(trainer.step)
 
     run_eval(trainer.step)

@@ -141,6 +141,12 @@ class StreamingLoader:
     def load_state_dict(self, d: dict) -> None:
         self.state = LoaderState.from_dict(d)
 
+    def reset(self) -> None:
+        """Rewind to the start of the stream — evals must score the SAME
+        fixed window every time (reference evaluates a fixed set per round;
+        a persistent loader would otherwise drift forward each call)."""
+        self.state = LoaderState()
+
     def skip_samples(self, n: int) -> None:
         """Fast-forward ``n`` samples without touching data (resume path)."""
         total = self.state.epoch * len(self.ds) + self.state.sample_in_epoch + n

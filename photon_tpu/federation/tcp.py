@@ -34,6 +34,7 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Any
 
 from photon_tpu.federation.driver import Driver
@@ -89,6 +90,9 @@ class TcpServerDriver(Driver):
         self.expected_nodes = expected_nodes
         self._nodes: dict[str, SocketConn] = {}
         self._inflight: dict[str, list[int]] = {}
+        # replies synthesized for sends to dead/unknown nodes, drained by
+        # recv_any before touching sockets
+        self._dead_letters: deque[tuple[str, int, Ack]] = deque()
         self._lock = threading.Lock()
         self._mid = iter(range(1 << 62))
         self._listener = socket.create_server((host, port))
@@ -144,7 +148,17 @@ class TcpServerDriver(Driver):
     def send(self, node_id: str, msg: Any) -> int:
         mid = next(self._mid)
         with self._lock:
-            conn = self._nodes[node_id]
+            conn = self._nodes.get(node_id)
+            if conn is None:
+                # node died and was dropped from the registry, but a caller
+                # (e.g. the sliding window's free list) still holds its id —
+                # synthesize a dead-node reply instead of raising KeyError
+                # and crashing the round loop the failure budget is meant to
+                # survive
+                self._dead_letters.append(
+                    (node_id, mid, Ack(ok=False, detail="node died", node_id=node_id))
+                )
+                return mid
             self._inflight[node_id].append(mid)
         try:
             conn.send(Envelope(msg, mid))
@@ -158,6 +172,8 @@ class TcpServerDriver(Driver):
         try:
             while True:
                 with self._lock:
+                    if self._dead_letters:
+                        return self._dead_letters.popleft()
                     watched = {
                         nid: conn
                         for nid, conn in self._nodes.items()

@@ -1,0 +1,253 @@
+"""Regression tests for the round-loop failure-path bugs found in rounds 1-2
+(ADVICE.md r1 findings a-d + the sliding-window timeout):
+
+a. ``TcpServerDriver.send`` to a dead/unknown node must synthesize a failure
+   reply, not KeyError-crash the round loop the failure budget exists to
+   survive.
+b. centralized mid-run eval must fire at its configured interval even when
+   save_every doesn't divide eval_interval.
+c. ``evaluate_round`` failures must respect ``ignore_failed_rounds``.
+d. eval rounds must score the SAME window of the val stream every time.
+e. ``recv_any`` TimeoutError inside the sliding window counts against the
+   failure budget instead of killing the server loop.
+"""
+
+import types
+from collections import deque
+
+import pytest
+
+from photon_tpu.federation import ServerApp, TooManyFailuresError
+from photon_tpu.federation.driver import Driver
+from photon_tpu.federation.messages import Ack
+from photon_tpu.federation.tcp import TcpServerDriver
+from tests.test_federation import make_app, make_cfg
+
+
+# ---------------------------------------------------------------------------
+# a. dead-node send
+# ---------------------------------------------------------------------------
+
+
+def test_tcp_send_to_unknown_node_synthesizes_failure():
+    """Sending to a node that died (already dropped from the registry, e.g.
+    its id still sits in the sliding window's free list) must not raise."""
+    driver = TcpServerDriver("127.0.0.1", 0, expected_nodes=0)
+    try:
+        mid = driver.send("ghost", {"kind": "fit"})  # never registered
+        nid, got_mid, reply = driver.recv_any(timeout=5)
+        assert (nid, got_mid) == ("ghost", mid)
+        assert isinstance(reply, Ack) and not reply.ok and "died" in reply.detail
+    finally:
+        driver.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# window-level fakes
+# ---------------------------------------------------------------------------
+
+
+class ScriptedDriver(Driver):
+    """Minimal driver: ``behavior(nid) -> "ok" | "die"`` decides each reply."""
+
+    def __init__(self, nodes: dict[str, str]) -> None:
+        self.behavior = dict(nodes)  # nid -> "ok" | "die"
+        self.alive = set(nodes)
+        self.sends: list[tuple[str, object]] = []
+        self._replies: deque[tuple[str, int, object]] = deque()
+        self._mid = iter(range(10**6))
+
+    def node_ids(self):
+        return sorted(self.alive)
+
+    def send(self, node_id, msg):
+        mid = next(self._mid)
+        self.sends.append((node_id, msg))
+        if node_id not in self.alive or self.behavior[node_id] == "die":
+            self.alive.discard(node_id)
+            self._replies.append(
+                (node_id, mid, Ack(ok=False, detail="node died", node_id=node_id))
+            )
+        else:
+            cid = msg[1][0] if isinstance(msg, tuple) else -1
+            self._replies.append(
+                (node_id, mid, types.SimpleNamespace(error=None, cid=cid))
+            )
+        return mid
+
+    def recv_any(self, timeout=None):
+        if not self._replies:
+            raise TimeoutError("scripted: nothing pending")
+        return self._replies.popleft()
+
+    def broadcast(self, msg):
+        return {nid: Ack(ok=True) for nid in self.alive}
+
+    def shutdown(self):
+        pass
+
+
+class StalledDriver(ScriptedDriver):
+    """Accepts sends but never replies: every recv_any times out."""
+
+    def send(self, node_id, msg):
+        self.sends.append((node_id, msg))
+        return next(self._mid)
+
+    def recv_any(self, timeout=None):
+        raise TimeoutError("stalled")
+
+
+def _window_app(tmp_path, driver, **fl_kw):
+    cfg = make_cfg(tmp_path, **fl_kw)
+    from photon_tpu.federation import ParamTransport
+
+    return ServerApp(cfg, driver, ParamTransport("inline"))
+
+
+def test_sliding_window_drops_dead_node_and_retries_elsewhere(tmp_path):
+    driver = ScriptedDriver({"n0": "ok", "n1": "die"})
+    app = _window_app(tmp_path, driver, accept_failures_cnt=0)
+    make_ins = lambda cids: ("fit", cids)  # noqa: E731
+    got = list(app._sliding_window(1, [0, 1], make_ins, timeout=5.0))
+    # both cids eventually succeed (the one that hit n1 retried on n0)
+    assert sorted(r.cid for r in got) == [0, 1]
+    # n1 died on its first task and was dropped from rotation: exactly 1 send
+    assert sum(1 for nid, _ in driver.sends if nid == "n1") == 1
+
+
+def test_sliding_window_all_nodes_dead_respects_budget(tmp_path):
+    driver = ScriptedDriver({"n0": "die"})
+    app = _window_app(tmp_path, driver, accept_failures_cnt=0)
+    make_ins = lambda cids: ("fit", cids)  # noqa: E731
+    with pytest.raises(TooManyFailuresError):
+        list(app._sliding_window(1, [0, 1, 2], make_ins, timeout=5.0))
+    # generous budget: the same situation is absorbed
+    app2 = _window_app(tmp_path, ScriptedDriver({"n0": "die"}), accept_failures_cnt=8)
+    assert list(app2._sliding_window(1, [0, 1, 2], make_ins, timeout=5.0)) == []
+
+
+def test_sliding_window_timeout_counts_against_budget(tmp_path):
+    """recv_any TimeoutError must convert to budgeted failures, not escape."""
+    driver = StalledDriver({"n0": "ok"})
+    app = _window_app(tmp_path, driver, accept_failures_cnt=0)
+    make_ins = lambda cids: ("fit", cids)  # noqa: E731
+    with pytest.raises(TooManyFailuresError) as ei:
+        list(app._sliding_window(1, [0, 1], make_ins, timeout=0.01))
+    assert "timeout" in str(ei.value) or "no live nodes" in str(ei.value)
+
+    app2 = _window_app(tmp_path, StalledDriver({"n0": "ok"}), accept_failures_cnt=8)
+    assert list(app2._sliding_window(1, [0, 1], make_ins, timeout=0.01)) == []
+
+
+# ---------------------------------------------------------------------------
+# c. evaluate_round under ignore_failed_rounds
+# ---------------------------------------------------------------------------
+
+
+def test_eval_round_failure_respects_ignore_failed_rounds(tmp_path, monkeypatch):
+    cfg = make_cfg(
+        tmp_path, n_rounds=1, eval_interval_rounds=1, ignore_failed_rounds=True
+    )
+    app = make_app(cfg, tmp_path)
+
+    def boom(server_round):
+        raise TooManyFailuresError("eval blew the budget")
+
+    monkeypatch.setattr(app, "evaluate_round", boom)
+    history = app.run()  # must NOT raise
+    assert history.latest("server/eval_round_failed") == 1.0
+    # the fit round itself still aggregated
+    assert history.latest("server/round_time") is not None
+    app.driver.shutdown()
+
+
+def test_eval_round_failure_raises_without_ignore(tmp_path, monkeypatch):
+    cfg = make_cfg(
+        tmp_path, n_rounds=1, eval_interval_rounds=1, ignore_failed_rounds=False
+    )
+    app = make_app(cfg, tmp_path)
+
+    def boom(server_round):
+        raise TooManyFailuresError("eval blew the budget")
+
+    monkeypatch.setattr(app, "evaluate_round", boom)
+    with pytest.raises(TooManyFailuresError):
+        app.run()
+    app.driver.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# b. centralized eval interval alignment
+# ---------------------------------------------------------------------------
+
+
+def test_centralized_eval_fires_at_configured_interval(tmp_path):
+    from photon_tpu.centralized import run_centralized
+
+    cfg = make_cfg(tmp_path)
+    cfg.photon.checkpoint = True
+    # save_every=5 does NOT divide eval_interval=3: before the fix, mid-run
+    # eval never fired because steps only stopped at save boundaries
+    history = run_centralized(
+        cfg, total_steps=6, eval_interval_steps=3, checkpoint_interval_steps=5
+    )
+    eval_steps = [s for s, _ in history.series("eval/loss")]
+    assert 3 in eval_steps, f"mid-run eval missing: {eval_steps}"
+    assert 6 in eval_steps  # final eval
+
+
+# ---------------------------------------------------------------------------
+# d. eval rounds score a fixed window
+# ---------------------------------------------------------------------------
+
+
+def test_eval_scores_identical_window_every_round(tmp_path):
+    from photon_tpu.federation import ParamTransport
+    from photon_tpu.federation.client_runtime import ClientRuntime
+    from photon_tpu.federation.messages import EvaluateIns
+
+    cfg = make_cfg(tmp_path)
+    rt = ClientRuntime(cfg, ParamTransport("inline"))
+    from photon_tpu.codec import params_to_ndarrays
+
+    meta, arrays = params_to_ndarrays(rt.trainer.state.params)
+    ptr = rt.transport.put("init", meta, arrays)
+    rt.set_broadcast_params(ptr)
+
+    ins = EvaluateIns(server_round=1, cids=[0], params=None, max_batches=2)
+    r1 = rt.evaluate(ins, cid=0)
+    r2 = rt.evaluate(ins, cid=0)
+    assert r1.error is None and r2.error is None
+    # same params + same fixed eval window => bit-identical loss
+    assert r1.loss == r2.loss
+    rt.close()
+
+
+def test_stale_reply_params_are_freed(tmp_path):
+    """A FitRes that arrives after its cid was written off (e.g. post-timeout)
+    must have its transport segment freed, not leaked."""
+
+    class StaleReplyDriver(ScriptedDriver):
+        def __init__(self):
+            super().__init__({"n0": "ok"})
+            self._injected = False
+
+        def recv_any(self, timeout=None):
+            if not self._injected:
+                self._injected = True
+                return (
+                    "n0",
+                    999_999,  # correlation id nobody is waiting for
+                    types.SimpleNamespace(error=None, cid=7, params="stale-ptr"),
+                )
+            return super().recv_any(timeout)
+
+    driver = StaleReplyDriver()
+    app = _window_app(tmp_path, driver, accept_failures_cnt=0)
+    freed = []
+    app.transport.free = lambda ptr: freed.append(ptr)
+    make_ins = lambda cids: ("fit", cids)  # noqa: E731
+    got = list(app._sliding_window(1, [0], make_ins, timeout=5.0))
+    assert sorted(r.cid for r in got) == [0]
+    assert freed == ["stale-ptr"]
