@@ -130,7 +130,14 @@ class TrainConfig:
     """Per-client training loop config (reference: Composer Trainer knobs)."""
 
     global_batch_size: int = 256
-    device_microbatch_size: int = 8  # grad-accumulation granularity
+    # grad-accumulation granularity; "auto" probes descending power-of-2
+    # sizes at trainer build and picks the largest that fits in HBM
+    # (reference: ``device_train_microbatch_size: auto``,
+    # ``photon/clients/trainer_utils.py:972-978``, ``mpt-125m.yaml:80-81``)
+    device_microbatch_size: int | str = 8
+    # tokens per chunk of the scanned cross-entropy (0 = materialize full
+    # logits); chunking keeps the fp32 [N, vocab] logits out of HBM
+    loss_chunk_tokens: int = 2048
     seed: int = 17
     precision: str = "amp_bf16"
     eval_interval: int = 0  # 0 = no mid-training eval
@@ -192,6 +199,8 @@ class FLConfig:
     # per-round client config knobs (reference FitConfig: reset_optimizer,
     # reset_dataset_state, client_checkpoints, ... — ``clients/configs.py:55-214``)
     fit_config: dict = field(default_factory=dict)
+    # eval-round knobs (reference EvaluateConfig, ``clients/configs.py:289-425``)
+    eval_config: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -259,7 +268,11 @@ class Config:
     def validate(self) -> "Config":
         if self.fl.n_clients_per_round > self.fl.n_total_clients:
             raise ValueError("n_clients_per_round > n_total_clients")
-        if self.train.global_batch_size % self.train.device_microbatch_size:
+        micro = self.train.device_microbatch_size
+        if isinstance(micro, str):
+            if micro != "auto":
+                raise ValueError(f"device_microbatch_size must be an int or 'auto', got {micro!r}")
+        elif self.train.global_batch_size % micro:
             raise ValueError("global_batch_size must be divisible by device_microbatch_size")
         StrategyName(self.fl.strategy_name)
         AttnImpl(self.model.attn_impl)

@@ -123,12 +123,18 @@ class _ScanBlock(nn.Module):
 
 
 class MPTModel(nn.Module):
-    """Decoder-only LM: tokens ``[B, S] int32`` → logits ``[B, S, vocab]``."""
+    """Decoder-only LM: tokens ``[B, S] int32`` → logits ``[B, S, vocab]``.
+
+    ``return_hidden=True`` stops after the final LayerNorm and returns
+    ``[B, S, d_model]`` hidden states instead — the training loss computes
+    logits chunkwise from these (``train_step.make_loss_fn``) so the full
+    fp32 ``[B, S, vocab]`` tensor is never materialized in HBM.
+    """
 
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:
+    def __call__(self, tokens: jax.Array, return_hidden: bool = False) -> jax.Array:
         cfg = self.cfg
         compute = _dtype(cfg.compute_dtype)
 
@@ -169,6 +175,8 @@ class MPTModel(nn.Module):
         x, _ = stack(x, None)
 
         x = FP32LayerNorm(use_bias=not cfg.no_bias, name="ln_f")(x)
+        if return_hidden:
+            return x
         if cfg.tie_embeddings:
             logits = wte.attend(x.astype(compute))
         else:

@@ -30,9 +30,64 @@ class TrainState:
     opt_state: Any
 
 
-def make_loss_fn(model: MPTModel) -> Callable:
+def _output_embedding(model: MPTModel, params) -> jax.Array:
+    """``[vocab, d_model]`` output projection weights (tied wte or lm_head)."""
+    if model.cfg.tie_embeddings:
+        return params["wte"]["embedding"]
+    return params["lm_head"]["kernel"].T
+
+
+def _chunked_ce_sum(
+    model: MPTModel, params, hidden: jax.Array, targets: jax.Array, chunk: int
+) -> jax.Array:
+    """Sum of next-token CE without materializing ``[N, vocab]`` logits.
+
+    TPU-first memory trick: the fp32 logits tensor for a 2048-seq microbatch
+    is ~0.4 GB/row and its HBM round-trips dominate the step (the reference
+    leans on CUDA fused CE inside llm-foundry for the same reason). Here the
+    flattened tokens are scanned in ``chunk``-sized pieces: each piece does a
+    bf16 MXU matmul with fp32 accumulation, reduces to per-token CE, and the
+    piece's logits die in registers/VMEM. ``jax.checkpoint`` makes the
+    backward recompute them per piece instead of stashing them.
+    """
+    b, s, d = hidden.shape
+    n = b * s
+    xf = hidden.reshape(n, d)
+    tf = targets.reshape(n)
+    n_chunks = -(-n // chunk)
+    pad = n_chunks * chunk - n
+    if pad:
+        xf = jnp.pad(xf, ((0, pad), (0, 0)))
+        tf = jnp.pad(tf, (0, pad))
+    mask = (jnp.arange(n_chunks * chunk) < n).astype(jnp.float32)
+    emb_t = _output_embedding(model, params).astype(hidden.dtype).T  # [d, vocab]
+
+    xs = xf.reshape(n_chunks, chunk, d)
+    ts = tf.reshape(n_chunks, chunk)
+    ms = mask.reshape(n_chunks, chunk)
+
+    def piece(carry, xtm):
+        xc, tc, mc = xtm
+        logits = jnp.dot(xc, emb_t, preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        return carry + jnp.sum((lse - gold) * mc), None
+
+    total, _ = jax.lax.scan(
+        jax.checkpoint(piece), jnp.zeros([], jnp.float32), (xs, ts, ms)
+    )
+    return total
+
+
+def make_loss_fn(model: MPTModel, loss_chunk_tokens: int = 2048) -> Callable:
     def loss_fn(params, tokens: jax.Array):
         """Mean next-token cross entropy over ``[B, S] int32`` tokens."""
+        if loss_chunk_tokens:
+            hidden = model.apply({"params": params}, tokens, return_hidden=True)
+            ce_sum = _chunked_ce_sum(
+                model, params, hidden[:, :-1], tokens[:, 1:], loss_chunk_tokens
+            )
+            return ce_sum / (tokens.shape[0] * (tokens.shape[1] - 1))
         logits = model.apply({"params": params}, tokens)
         targets = tokens[:, 1:]
         logits = logits[:, :-1]
@@ -48,6 +103,7 @@ def make_train_step(
     model: MPTModel,
     tx: optax.GradientTransformation,
     n_microbatches: int = 1,
+    loss_chunk_tokens: int = 2048,
 ) -> Callable:
     """Build the pure train-step fn ``(state, tokens) -> (state, metrics)``.
 
@@ -56,7 +112,7 @@ def make_train_step(
     analog of the reference's ``device_train_microbatch_size`` grad
     accumulation (``conf/llm_config/mpt-125m.yaml:80-81``).
     """
-    loss_fn = make_loss_fn(model)
+    loss_fn = make_loss_fn(model, loss_chunk_tokens)
     grad_fn = jax.value_and_grad(loss_fn)
 
     def train_step(state: TrainState, tokens: jax.Array):
@@ -92,11 +148,18 @@ def make_train_step(
     return train_step
 
 
-def make_eval_step(model: MPTModel) -> Callable:
+def make_eval_step(model: MPTModel, loss_chunk_tokens: int = 2048) -> Callable:
     """``(params, tokens) -> (sum_ce, n_tokens)`` for loss aggregation across
     eval batches (reference: ``llm_eval`` collecting ``eval_metric_values``,
     ``clients/llm_client_functions.py:231-353``)."""
     def eval_step(params, tokens: jax.Array):
+        n_tok = tokens.shape[0] * (tokens.shape[1] - 1)
+        if loss_chunk_tokens:
+            hidden = model.apply({"params": params}, tokens, return_hidden=True)
+            ce_sum = _chunked_ce_sum(
+                model, params, hidden[:, :-1], tokens[:, 1:], loss_chunk_tokens
+            )
+            return ce_sum, jnp.asarray(n_tok, jnp.int32)
         logits = model.apply({"params": params}, tokens)
         targets = tokens[:, 1:]
         ce = optax.softmax_cross_entropy_with_integer_labels(

@@ -64,33 +64,48 @@ class Trainer:
         self.tx, self.lr_schedule = build_optimizer(cfg.optimizer, cfg.scheduler)
         self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
 
-        # device_microbatch_size is PER DEVICE (reference:
-        # ``device_train_microbatch_size``); a scan step processes
-        # micro × dp_degree global rows, where dp_degree covers the batch-
-        # sharded mesh axes (data and fsdp)
-        dp_degree = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
-        rows_per_scan = cfg.train.device_microbatch_size * dp_degree
-        n_micro = max(1, cfg.train.global_batch_size // rows_per_scan)
-        step_fn = make_train_step(self.model, self.tx, n_microbatches=n_micro)
-        self._n_micro = n_micro
         self._last_set_time = 0.0
 
         if params is None:
             params = init_params(cfg.model, seed=cfg.seed if init_seed is None else init_seed)
         host_state = init_train_state(self.model, self.tx, params)
         self._shardings = state_shardings(host_state, self.mesh)
+        self._batch_sharding = NamedSharding(self.mesh, batch_spec(self.mesh))
+
+        # device_microbatch_size is PER DEVICE (reference:
+        # ``device_train_microbatch_size``); a scan step processes
+        # micro × dp_degree global rows, where dp_degree covers the batch-
+        # sharded mesh axes (data and fsdp)
+        dp_degree = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        micro = cfg.train.device_microbatch_size
+        probed_step = None
+        if micro == "auto":
+            # OOM-adaptive probe (reference:
+            # ``device_train_microbatch_size: auto``,
+            # ``photon/clients/trainer_utils.py:972-978``)
+            micro, probed_step = self._probe_microbatch(host_state, dp_degree)
+        self.device_microbatch_size = micro
+        rows_per_scan = micro * dp_degree
+        n_micro = max(1, cfg.train.global_batch_size // rows_per_scan)
+        self._n_micro = n_micro
+
         self.state: TrainState = jax.tree.map(
             lambda leaf, sh: jax.device_put(leaf, sh), host_state, self._shardings
         )
-        self._batch_sharding = NamedSharding(self.mesh, batch_spec(self.mesh))
-        jitted_train = jax.jit(
-            step_fn,
-            in_shardings=(self._shardings, self._batch_sharding),
-            out_shardings=(self._shardings, None),
-            donate_argnums=0,
-        )
+        if probed_step is not None:
+            jitted_train = probed_step  # reuse the winner's compile
+        else:
+            jitted_train = jax.jit(
+                make_train_step(
+                    self.model, self.tx, n_microbatches=n_micro,
+                    loss_chunk_tokens=cfg.train.loss_chunk_tokens,
+                ),
+                in_shardings=(self._shardings, self._batch_sharding),
+                out_shardings=(self._shardings, None),
+                donate_argnums=0,
+            )
         jitted_eval = jax.jit(
-            make_eval_step(self.model),
+            make_eval_step(self.model, loss_chunk_tokens=cfg.train.loss_chunk_tokens),
             in_shardings=(self._shardings.params, self._batch_sharding),
         )
 
@@ -108,6 +123,87 @@ class Trainer:
 
         self._train_step = _train
         self._eval_step = _eval
+
+    # ------------------------------------------------------------------
+    # auto microbatch probe
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _is_oom(e: Exception) -> bool:
+        msg = str(e)
+        return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "out of memory" in msg
+
+    def _probe_microbatch(self, host_state: TrainState, dp_degree: int):
+        """Largest power-of-2 per-device microbatch that compiles AND executes
+        one real (donated) train step without exhausting HBM (reference:
+        ``device_train_microbatch_size: auto`` halving on CUDA OOM,
+        ``photon/clients/trainer_utils.py:972-978``).
+
+        Each candidate builds a fresh device state so the probe's memory
+        profile matches the real step exactly; the probe state is freed before
+        the persistent one is created. Returns ``(microbatch, jitted_step)``
+        so the winner's (possibly minutes-long) compile is reused for the
+        persistent train step instead of being paid twice.
+        """
+        from photon_tpu.parallel.context import use_mesh
+
+        cfg = self.cfg
+        per_device_rows = max(1, cfg.train.global_batch_size // dp_degree)
+        cand = 1 << (per_device_rows.bit_length() - 1)  # largest pow2 <= rows
+        seq = cfg.model.max_seq_len
+        last_err: Exception | None = None
+        probed_any = False
+        # stage through host numpy: device_put of an already-correctly-sharded
+        # device array is a no-copy alias, and donating an alias would delete
+        # the very buffers the persistent state is built from afterwards
+        host_state = jax.tree.map(np.asarray, host_state)
+        while cand >= 1:
+            rows = cand * dp_degree
+            if cfg.train.global_batch_size % rows:
+                cand //= 2  # scan needs equal chunks
+                continue
+            n_micro = max(1, cfg.train.global_batch_size // rows)
+            probed_any = True
+            try:
+                step = jax.jit(
+                    make_train_step(
+                        self.model, self.tx, n_microbatches=n_micro,
+                        loss_chunk_tokens=cfg.train.loss_chunk_tokens,
+                    ),
+                    in_shardings=(self._shardings, self._batch_sharding),
+                    out_shardings=(self._shardings, None),
+                    donate_argnums=0,
+                )
+                state = jax.tree.map(
+                    lambda leaf, sh: jax.device_put(leaf, sh), host_state, self._shardings
+                )
+                tokens = jax.device_put(
+                    np.zeros((cfg.train.global_batch_size, seq), np.int32),
+                    self._batch_sharding,
+                )
+                with use_mesh(self.mesh):
+                    new_state, _ = step(state, tokens)
+                jax.block_until_ready(new_state)
+                del state, new_state, tokens
+                return cand, step
+            except Exception as e:  # noqa: BLE001 — only OOM is retryable
+                # free the failed candidate's device buffers BEFORE the next
+                # (smaller) candidate allocates its own full TrainState, or
+                # every retry probes under ~2x state HBM pressure
+                state = new_state = tokens = None  # noqa: F841 — drop refs
+                if not self._is_oom(e):
+                    raise
+                last_err = e
+                cand //= 2
+        if not probed_any:
+            raise ValueError(
+                "auto microbatch: no power-of-2 per-device microbatch divides "
+                f"global_batch_size={cfg.train.global_batch_size} over "
+                f"dp_degree={dp_degree}; set device_microbatch_size explicitly"
+            )
+        raise RuntimeError(
+            f"auto microbatch: even microbatch 1 exhausts device memory: {last_err}"
+        )
 
     # ------------------------------------------------------------------
     # training / eval loops
@@ -157,7 +253,10 @@ class Trainer:
                         callback(i, metrics)
         finally:
             it.close()
-        jax.block_until_ready(self.state.step)
+        # block on the WHOLE state: some backends (the axon TPU relay) mark
+        # output buffers ready per-buffer, so blocking on .step alone returns
+        # before params/opt_state finish computing and wall-time undercounts
+        jax.block_until_ready(self.state)
         dt = time.monotonic() - t0
         return {
             **last_metrics,

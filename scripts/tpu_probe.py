@@ -1,0 +1,106 @@
+"""Interactive TPU probe: find the fastest (remat, microbatch) config for the
+125M recipe on the attached chip. Not part of the bench; a tuning tool.
+
+Usage: python scripts/tpu_probe.py 'remat,micro,gbs,steps[,impl]' ...
+e.g.   python scripts/tpu_probe.py 1,4,16,8 1,8,16,8 0,4,16,8,xla
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import time
+
+import jax
+
+HERE = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(HERE))
+
+cache_dir = HERE / ".jax_cache"
+cache_dir.mkdir(exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def log(msg: str) -> None:
+    print(f"[probe] {msg}", file=sys.stderr, flush=True)
+
+
+def probe(remat: bool, micro: int, gbs: int, steps: int, impl: str = "pallas") -> dict:
+    import numpy as np
+
+    from photon_tpu.config.schema import Config
+    from photon_tpu.parallel.mesh import single_device_mesh
+    from photon_tpu.train.trainer import Trainer
+    from photon_tpu.utils.profiling import model_flops_per_token, peak_flops_for_device_kind
+
+    cfg = Config()
+    cfg.model.attn_impl = impl
+    cfg.model.remat = remat
+    cfg.train.device_microbatch_size = micro
+    cfg.train.global_batch_size = gbs
+    cfg.validate()
+    seq = cfg.model.max_seq_len
+
+    t0 = time.perf_counter()
+    trainer = Trainer(cfg, mesh=single_device_mesh())
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return rng.integers(0, cfg.model.vocab_size, (gbs, seq), dtype=np.int32)
+
+    trainer.state, m0 = trainer._train_step(trainer.state, batch())
+    float(m0["loss"])
+    compile_s = time.perf_counter() - t0
+    trainer.state, m0 = trainer._train_step(trainer.state, batch())
+    float(m0["loss"])
+
+    # timed window closed by a HOST FETCH of the last loss: on the axon relay
+    # even block_until_ready on every output can return early when XLA aliases
+    # donated buffers, but a device->host transfer of a value that depends on
+    # the whole step chain cannot complete before the work is done
+    t1 = time.perf_counter()
+    for _ in range(steps):
+        trainer.state, m = trainer._train_step(trainer.state, batch())
+    loss = float(m["loss"])  # forces steps 1..N (loss_N depends on params_{N-1})
+    dt = time.perf_counter() - t1
+    toks = steps * gbs * seq / dt
+    dev = jax.devices()[0]
+    peak = peak_flops_for_device_kind(dev.device_kind)
+    mfu = toks * model_flops_per_token(cfg.model) / peak
+    del trainer
+    return {
+        "remat": remat, "micro": micro, "gbs": gbs, "steps": steps, "impl": impl,
+        "compile_s": round(compile_s, 1), "tokens_per_sec": round(toks, 1),
+        "mfu": round(mfu, 4), "loss": round(loss, 3),
+        "step_ms": round(1000 * dt / steps, 1),
+    }
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    log(f"device: {dev} kind={dev.device_kind}")
+    results = []
+    for spec in sys.argv[1:]:
+        parts = spec.split(",")
+        remat, micro, gbs, steps = (int(x) for x in parts[:4])
+        impl = parts[4] if len(parts) > 4 else "pallas"
+        log(f"--- config remat={bool(remat)} micro={micro} gbs={gbs} steps={steps} impl={impl}")
+        try:
+            r = probe(bool(remat), micro, gbs, steps, impl)
+            log(f"    -> {r}")
+            results.append(r)
+        except Exception as e:  # noqa: BLE001 - report every config
+            from photon_tpu.train.trainer import Trainer as _T
+
+            oom = _T._is_oom(e)
+            msg = str(e)
+            log(f"    -> FAILED oom={oom}: {msg.splitlines()[0][:200]}")
+            results.append({"remat": bool(remat), "micro": micro, "gbs": gbs,
+                            "error": "oom" if oom else msg[:200]})
+    print(json.dumps(results, indent=2), flush=True)
+
+
+if __name__ == "__main__":
+    main()

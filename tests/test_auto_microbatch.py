@@ -1,0 +1,95 @@
+"""``device_microbatch_size: "auto"`` — the OOM-adaptive probe (reference:
+``device_train_microbatch_size: auto``, ``photon/clients/trainer_utils.py:972-978``)."""
+
+import numpy as np
+import pytest
+
+from photon_tpu.config.schema import (
+    Config,
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    TrainConfig,
+)
+from photon_tpu.train.trainer import Trainer
+
+
+def _cfg(**train_kw):
+    train = dict(global_batch_size=4, device_microbatch_size="auto")
+    train.update(train_kw)
+    return Config(
+        model=ModelConfig(
+            d_model=32, n_layers=2, n_heads=2, max_seq_len=16, vocab_size=64,
+            attn_impl="xla", compute_dtype="float32",
+        ),
+        mesh=MeshConfig(),
+        optimizer=OptimizerConfig(name="adopt", lr=1e-3),
+        scheduler=SchedulerConfig(t_warmup=2, t_max=50),
+        train=TrainConfig(**train),
+    ).validate()
+
+
+def test_auto_picks_largest_fitting_microbatch():
+    """No memory pressure on CPU: auto lands on the full per-device batch."""
+    trainer = Trainer(_cfg(), init_seed=0)
+    assert trainer.device_microbatch_size == 4
+    assert trainer._n_micro == 1
+    batch = np.random.default_rng(0).integers(0, 64, (4, 16), dtype=np.int32)
+    trainer.state, m = trainer._train_step(trainer.state, batch)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_auto_halves_on_oom(monkeypatch):
+    """Simulated RESOURCE_EXHAUSTED for microbatch > 1 drives the probe down
+    to the largest size that 'fits'."""
+    import photon_tpu.train.trainer as trainer_mod
+
+    real_make = trainer_mod.make_train_step
+    probed = []
+
+    def fake_make(model, tx, n_microbatches=1, **kw):
+        # gbs=4: n_micro==1 -> micro=4, n_micro==2 -> micro=2, ...
+        micro = 4 // n_microbatches
+        probed.append(micro)
+        if micro > 1:
+            def boom(state, tokens):
+                raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory (simulated)")
+            return boom
+        return real_make(model, tx, n_microbatches=n_microbatches, **kw)
+
+    monkeypatch.setattr(trainer_mod, "make_train_step", fake_make)
+    trainer = Trainer(_cfg(), init_seed=0)
+    assert trainer.device_microbatch_size == 1
+    assert probed[:3] == [4, 2, 1]  # descending powers of two
+
+
+def test_auto_raises_when_nothing_fits(monkeypatch):
+    import photon_tpu.train.trainer as trainer_mod
+
+    def always_boom(model, tx, n_microbatches=1, **kw):
+        def boom(state, tokens):
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory (simulated)")
+        return boom
+
+    monkeypatch.setattr(trainer_mod, "make_train_step", always_boom)
+    with pytest.raises(RuntimeError, match="even microbatch 1"):
+        Trainer(_cfg(), init_seed=0)
+
+
+def test_non_oom_probe_error_propagates(monkeypatch):
+    import photon_tpu.train.trainer as trainer_mod
+
+    def broken(model, tx, n_microbatches=1, **kw):
+        def boom(state, tokens):
+            raise ValueError("a real bug, not OOM")
+        return boom
+
+    monkeypatch.setattr(trainer_mod, "make_train_step", broken)
+    with pytest.raises(ValueError, match="real bug"):
+        Trainer(_cfg(), init_seed=0)
+
+
+def test_schema_rejects_bad_string():
+    with pytest.raises(ValueError, match="auto"):
+        _cfg(device_microbatch_size="Auto")
