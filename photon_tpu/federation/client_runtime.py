@@ -37,6 +37,7 @@ from photon_tpu.checkpoint.client import ClientCheckpointManager
 from photon_tpu.codec import ParamsMetadata
 from photon_tpu.config.schema import Config
 from photon_tpu.data import LoaderState, ShardedDataset, StreamingLoader, make_synthetic_dataset
+from photon_tpu.federation.configs import EvaluateRoundConfig, FitRoundConfig
 from photon_tpu.federation.messages import ClientState, EvaluateIns, EvaluateRes, FitIns, FitRes
 from photon_tpu.federation.transport import ParamTransport
 from photon_tpu.train.trainer import Trainer
@@ -119,13 +120,17 @@ class ClientRuntime:
 
     def _fit_inner(self, ins: FitIns, cid: int, t_start: float) -> FitRes:
         cfg = self.cfg
+        # validated per-round knobs: a typo'd key raises (surfaced as an error
+        # FitRes) instead of silently no-opping (reference pydantic FitConfig,
+        # ``clients/configs.py:55-214``)
+        knobs = FitRoundConfig.from_dict(ins.config)
         state_in = ClientState.from_dict(ins.client_states[cid]) if cid in ins.client_states else ClientState(cid)
         target_step = ins.server_steps_cumulative + ins.local_steps
 
         # skip-if-done: post-round client checkpoint already exists
         if (
             self.ckpt_mgr is not None
-            and ins.config.get("client_checkpoints", False)
+            and knobs.client_checkpoints
             and self.ckpt_mgr.should_skip_round(cid, target_step)
         ):
             pm, pa, opt, extra = self.ckpt_mgr.load(cid, target_step)
@@ -155,13 +160,13 @@ class ClientRuntime:
         else:
             base_meta, params_in, m1_in, m2_in = meta, list(arrays), None, None
 
-        if ins.config.get("personalize_patterns"):
+        if knobs.personalize_patterns:
             params_in = personalize_layers(
-                base_meta, params_in, self._personal.get(cid), ins.config["personalize_patterns"]
+                base_meta, params_in, self._personal.get(cid), knobs.personalize_patterns
             )
-        if ins.config.get("randomize_patterns"):
+        if knobs.randomize_patterns:
             params_in = randomize_layers(
-                base_meta, params_in, ins.config["randomize_patterns"],
+                base_meta, params_in, knobs.randomize_patterns,
                 seed=_stable_seed(cid, ins.server_round),
             )
 
@@ -169,7 +174,7 @@ class ClientRuntime:
         initial = [a.copy() for a in params_in]
 
         # reset knobs (reference: ``load_ignore_keys`` globs, ``clients/utils.py:219-249``)
-        if ins.config.get("reset_optimizer", False):
+        if knobs.reset_optimizer:
             self.trainer.reset_optimizer()
         elif carry_momenta:
             self.trainer.set_momenta(m1_in, m2_in)
@@ -177,10 +182,10 @@ class ClientRuntime:
 
         fresh = (cid, cfg.dataset.split_train) not in self._loaders
         loader = self._loader(cid, cfg.dataset.split_train, cfg.train.global_batch_size)
-        if ins.config.get("reset_dataset_state", False):
-            loader.load_state_dict(LoaderState().to_dict())
-        elif "loader_state" in ins.config:
-            loader.load_state_dict(ins.config["loader_state"][cid])
+        if knobs.reset_dataset_state:
+            loader.reset()
+        elif knobs.loader_state is not None:
+            loader.load_state_dict(knobs.loader_state[cid])
         elif fresh and state_in.samples_cumulative > 0:
             # node restart / server resume: a fresh loader fast-forwards to the
             # client's cumulative sample position so the data order matches an
@@ -202,13 +207,13 @@ class ClientRuntime:
         fit_metrics["client/pseudo_grad_norm"] = _l2(delta)
         fit_metrics["client/param_norm"] = _l2(out_arrays)
 
-        if ins.config.get("personalize_patterns"):
+        if knobs.personalize_patterns:
             self._personal[cid] = [a.copy() for a in out_arrays]
         if carry_momenta:
             m1_out, m2_out = self.trainer.get_momenta()
             out_meta, out_arrays = extend_with_momenta(out_meta, out_arrays, m1_out, m2_out)
 
-        if self.ckpt_mgr is not None and ins.config.get("client_checkpoints", False):
+        if self.ckpt_mgr is not None and knobs.client_checkpoints:
             om, oa = self.trainer.get_opt_state_arrays()
             self.ckpt_mgr.save(
                 cid, target_step, out_meta, out_arrays, om, oa,
@@ -253,6 +258,9 @@ class ClientRuntime:
     # -- eval ------------------------------------------------------------
     def evaluate(self, ins: EvaluateIns, cid: int) -> EvaluateRes:
         try:
+            # validate knobs BEFORE the expensive compute (matches the fit
+            # path's fail-fast at the top of _fit_inner)
+            eval_knobs = EvaluateRoundConfig.from_dict(ins.config)
             meta, arrays = self._resolve_params(ins.params)
             from photon_tpu.train.param_ops import has_momenta, split_momenta
 
@@ -261,10 +269,18 @@ class ClientRuntime:
             self.trainer.set_parameters(meta, arrays)
             cfg = self.cfg
             loader = self._loader(cid, cfg.dataset.split_eval, cfg.train.global_batch_size)
+            loader.reset()  # every eval round scores the same fixed window
             n_batches = ins.max_batches or cfg.train.eval_batches
             batches = [next(loader) for _ in range(n_batches)]
             out = self.trainer.evaluate(batches)
-            out.update(self._unigram_metrics(cid, batches, out["eval/loss"]))
+            if eval_knobs.use_unigram_metrics:
+                uni = self._unigram_metrics(cid, batches, out["eval/loss"])
+                if not uni and not eval_knobs.allow_unigram_failures:
+                    raise FileNotFoundError(
+                        f"unigram freq dict missing for client {cid} and "
+                        "allow_unigram_failures is False"
+                    )
+                out.update(uni)
             return EvaluateRes(
                 server_round=ins.server_round,
                 cid=cid,
