@@ -102,16 +102,105 @@ class FileStore(ObjectStore):
             return []
         out: Iterable[pathlib.Path] = base.rglob("*") if base.is_dir() else [base]
         root = self.root.resolve()
-        return sorted(str(p.resolve().relative_to(root)) for p in out if p.is_file())
+        return sorted(
+            str(p.resolve().relative_to(root))
+            for p in out
+            # in-flight atomic-write temp files are not objects yet
+            if p.is_file() and not (p.name.startswith(".") and ".tmp-" in p.name)
+        )
+
+
+class S3Store(ObjectStore):
+    """S3/GCS-interop object store (reference: boto3 via Composer's
+    RemoteUploaderDownloader, ``photon/server/s3_utils.py:730-933``).
+
+    ``client`` is any object with the boto3 S3-client surface used here
+    (``put_object``/``get_object``/``head_object``/``delete_object``/
+    ``copy_object``/``get_paginator("list_objects_v2")``); the default
+    factory imports boto3 lazily, so environments without it can still
+    construct the class with an injected client (the contract tests do).
+    """
+
+    def __init__(self, bucket: str, prefix: str = "", client=None) -> None:
+        if client is None:
+            try:
+                import boto3  # noqa: PLC0415 — gated optional dep
+            except ImportError as e:
+                raise NotImplementedError(
+                    "s3:// backend requires boto3, which is unavailable here; "
+                    "mount the bucket and use a file path instead"
+                ) from e
+            client = boto3.client("s3")
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.client = client
+
+    def _key(self, key: str) -> str:
+        key = key.strip("/")
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def put(self, key: str, data: bytes) -> None:
+        # S3 PUT is atomic: readers never observe partial objects (the
+        # property the reference polls on, ``s3_utils.py:812-864``)
+        self.client.put_object(Bucket=self.bucket, Key=self._key(key), Body=data)
+
+    def get(self, key: str) -> bytes:
+        resp = self.client.get_object(Bucket=self.bucket, Key=self._key(key))
+        return resp["Body"].read()
+
+    def exists(self, key: str) -> bool:
+        try:
+            self.client.head_object(Bucket=self.bucket, Key=self._key(key))
+            return True
+        except Exception as e:  # noqa: BLE001 — botocore ClientError w/o import
+            code = getattr(e, "response", {}).get("Error", {}).get("Code", "")
+            if code in ("404", "NoSuchKey", "NotFound"):
+                return False
+            raise
+
+    def delete(self, key: str) -> None:
+        full = self._key(key)
+        # delete both the exact object and any "directory" under it,
+        # matching FileStore.delete on a dir
+        self.client.delete_object(Bucket=self.bucket, Key=full)
+        for sub in self.list(key):
+            self.client.delete_object(Bucket=self.bucket, Key=self._key(sub))
+
+    def list(self, prefix: str) -> list[str]:
+        # trailing slash on the store prefix so a sibling key like
+        # "<prefix>-old/x" can't bleed into a bare list("")
+        base = f"{self.prefix}/" if self.prefix else ""
+        full = self._key(prefix) if prefix else base
+        pager = self.client.get_paginator("list_objects_v2")
+        out = []
+        for page in pager.paginate(Bucket=self.bucket, Prefix=full):
+            for item in page.get("Contents", []):
+                k = item["Key"]
+                rel = k[len(base):] if base and k.startswith(base) else k
+                # a bare-file prefix match lists just that file; a dir-like
+                # prefix must not match sibling files sharing the string
+                # prefix (FileStore semantics: path components)
+                if not prefix or rel == prefix or rel.startswith(prefix.strip("/") + "/"):
+                    out.append(rel)
+        return sorted(out)
+
+    def copy(self, src_key: str, dst_key: str) -> None:
+        self.client.copy_object(
+            Bucket=self.bucket,
+            Key=self._key(dst_key),
+            CopySource={"Bucket": self.bucket, "Key": self._key(src_key)},
+        )
 
 
 def make_store(uri: str) -> ObjectStore:
-    """``/path`` or ``file:///path`` → FileStore; ``s3://`` reserved."""
+    """``/path`` or ``file:///path`` → FileStore; ``s3://bucket/prefix`` →
+    S3Store (requires boto3)."""
     if uri.startswith("file://"):
         return FileStore(uri[len("file://") :])
     if uri.startswith("s3://"):
-        raise NotImplementedError(
-            "s3:// backend requires boto3 (not in this image); mount the bucket "
-            "and use a file path instead"
-        )
+        rest = uri[len("s3://") :]
+        bucket, _, prefix = rest.partition("/")
+        if not bucket:
+            raise ValueError(f"bad s3 uri {uri!r}")
+        return S3Store(bucket, prefix)
     return FileStore(uri)

@@ -1,0 +1,196 @@
+"""Object-store contract suite (VERDICT r2 item 8): every backend must obey
+the same semantics the round loop and checkpoint managers rely on — atomic
+visibility, path-component prefix listing, wait_for polling.
+
+Runs against FileStore and against S3Store driven by an in-memory fake of the
+boto3 client surface (boto3 itself is optional; the fake exercises S3Store's
+real key/prefix/pagination logic either way). Reference behavior being
+matched: ``photon/server/s3_utils.py:730-933``.
+"""
+
+import threading
+import time
+
+import pytest
+
+from photon_tpu.checkpoint.store import FileStore, ObjectStore, S3Store, make_store
+
+
+class FakeS3Client:
+    """In-memory boto3-S3-client lookalike (only the surface S3Store uses),
+    with V2-style pagination to exercise the pagination path."""
+
+    PAGE = 3  # tiny page size so multi-page listing is actually tested
+
+    def __init__(self):
+        self.blobs: dict[str, bytes] = {}
+        self.lock = threading.Lock()
+
+    def put_object(self, Bucket, Key, Body):
+        with self.lock:
+            self.blobs[Key] = bytes(Body)
+
+    def get_object(self, Bucket, Key):
+        class _Body:
+            def __init__(self, data):
+                self._data = data
+
+            def read(self):
+                return self._data
+
+        if Key not in self.blobs:
+            raise self._not_found()
+        return {"Body": _Body(self.blobs[Key])}
+
+    def head_object(self, Bucket, Key):
+        if Key not in self.blobs:
+            raise self._not_found()
+        return {}
+
+    def delete_object(self, Bucket, Key):
+        with self.lock:
+            self.blobs.pop(Key, None)
+
+    def copy_object(self, Bucket, Key, CopySource):
+        self.blobs[Key] = self.blobs[CopySource["Key"]]
+
+    def get_paginator(self, op):
+        assert op == "list_objects_v2"
+        outer = self
+
+        class _Pager:
+            def paginate(self, Bucket, Prefix):
+                keys = sorted(k for k in outer.blobs if k.startswith(Prefix))
+                for i in range(0, len(keys), outer.PAGE):
+                    yield {"Contents": [{"Key": k} for k in keys[i : i + outer.PAGE]]}
+                if not keys:
+                    yield {}
+
+        return _Pager()
+
+    @staticmethod
+    def _not_found():
+        e = Exception("NoSuchKey")
+        e.response = {"Error": {"Code": "404"}}
+        return e
+
+
+@pytest.fixture(params=["file", "s3"])
+def store(request, tmp_path) -> ObjectStore:
+    if request.param == "file":
+        return FileStore(tmp_path / "store")
+    return S3Store("bucket", prefix="runs/test", client=FakeS3Client())
+
+
+def test_put_get_roundtrip_and_overwrite(store):
+    store.put("a/b/blob.bin", b"v1")
+    assert store.get("a/b/blob.bin") == b"v1"
+    store.put("a/b/blob.bin", b"v2-longer")
+    assert store.get("a/b/blob.bin") == b"v2-longer"
+
+
+def test_exists_lifecycle(store):
+    assert not store.exists("x")
+    store.put("x", b"1")
+    assert store.exists("x")
+    store.delete("x")
+    assert not store.exists("x")
+
+
+def test_delete_is_idempotent(store):
+    store.delete("never/existed")  # must not raise
+
+
+def test_delete_directory_like(store):
+    store.put("run/1/a", b"1")
+    store.put("run/1/b", b"2")
+    store.put("run/2/a", b"3")
+    store.delete("run/1")
+    assert store.list("run") == ["run/2/a"]
+
+
+def test_list_prefix_is_path_component_based(store):
+    """'a/b' must not match sibling 'a/bc' (string-prefix bleed)."""
+    store.put("a/b/one", b"1")
+    store.put("a/b/two", b"2")
+    store.put("a/bc/three", b"3")
+    assert store.list("a/b") == ["a/b/one", "a/b/two"]
+    assert store.list("a") == ["a/b/one", "a/b/two", "a/bc/three"]
+    assert store.list("missing") == []
+
+
+def test_list_many_pages(store):
+    keys = [f"p/{i:03d}" for i in range(10)]
+    for k in keys:
+        store.put(k, b"x")
+    assert store.list("p") == keys  # FakeS3Client pages at 3 → 4 pages
+
+
+def test_copy(store):
+    store.put("src", b"payload")
+    store.copy("src", "deep/dst")
+    assert store.get("deep/dst") == b"payload"
+    assert store.get("src") == b"payload"
+
+
+def test_wait_for_sees_concurrent_writer(store):
+    t = threading.Timer(0.15, lambda: store.put("late", b"here"))
+    t.start()
+    store.wait_for("late", timeout=5.0, poll=0.01)
+    assert store.get("late") == b"here"
+    t.join()
+
+
+def test_wait_for_times_out(store):
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        store.wait_for("never", timeout=0.2, poll=0.02)
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_get_missing_raises(store):
+    with pytest.raises(Exception):
+        store.get("missing-key")
+
+
+# -- backend-specific ------------------------------------------------------
+
+
+def test_filestore_tmp_files_invisible(tmp_path):
+    """Atomic-visibility detail: in-flight temp files never appear in list()
+    or exists() (reference relies on S3 atomic PUT; FileStore gets the same
+    property from tmp+rename)."""
+    fs = FileStore(tmp_path / "s")
+    (fs.root / ".blob.tmp-999").write_bytes(b"partial")
+    assert fs.list("") == []
+    assert not fs.exists("blob")
+
+
+def test_filestore_rejects_escaping_keys(tmp_path):
+    fs = FileStore(tmp_path / "s")
+    with pytest.raises(ValueError):
+        fs.put("../outside", b"x")
+
+
+def test_s3store_prefix_isolation():
+    client = FakeS3Client()
+    a = S3Store("b", prefix="run-a", client=client)
+    b = S3Store("b", prefix="run-b", client=client)
+    a.put("k", b"A")
+    b.put("k", b"B")
+    assert a.get("k") == b"A" and b.get("k") == b"B"
+    assert a.list("") == ["k"]
+
+
+def test_make_store_dispatch(tmp_path):
+    assert isinstance(make_store(str(tmp_path / "x")), FileStore)
+    assert isinstance(make_store(f"file://{tmp_path}/y"), FileStore)
+    try:
+        import boto3  # noqa: F401
+
+        assert isinstance(make_store("s3://bucket/prefix"), S3Store)
+    except ImportError:
+        with pytest.raises(NotImplementedError, match="boto3"):
+            make_store("s3://bucket/prefix")
+    with pytest.raises(ValueError):
+        make_store("s3://")
