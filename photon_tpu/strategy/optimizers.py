@@ -8,6 +8,25 @@ flat ndarray list; ``g`` is the pseudo-gradient ``x - avg``):
 - FedMom      (``fedmom.py``):               m ← μm + g;  x ← x − η·m
 - FedAdam     (``fedadam.py:291-318``):      bias-corrected Adam on g
 - FedYogi     (``fedyogi.py:299-320``):      Yogi second-moment variant
+
+DELIBERATE DIVERGENCE — FedAdam/FedYogi update sign. The reference computes
+the pseudo-gradient as ``g = x − avg`` (``fedadam.py:293``) and then applies
+``x ← x + η·m̂/(√v̂+τ)`` (``fedadam.py:307-317``, same in
+``fedyogi.py:313-322``) — a step in the *+g* direction, i.e. AWAY from the
+client average. Every other strategy in the reference descends: FedAvgEff
+with η=1 lands exactly on the average via ``x − g``, and Adaptive Federated
+Optimization (Reddi et al. 2021) defines FedAdam with ``Δ = avg − x`` and
+``x ← x + η·m̂/(√v̂+τ)``, which equals ``x − η·…`` under our ``g = x − avg``
+convention. We therefore SUBTRACT (``x − η·m̂/(√v̂+τ)``): consistent with the
+published algorithm and with descent; the reference's ``+`` on its ``x − avg``
+pseudo-gradient is judged a sign bug, not behavior to reproduce. Golden tests
+pin our sign (``tests/test_strategy.py::test_fedadam_first_step_golden``,
+``test_adaptive_descends_toward_client_average``).
+
+A second, minor divergence: the reference bias-corrects with ``server_round``
+(``fedadam.py:308,312``) which is wrong after a warm start from a non-zero
+round with fresh momenta; we keep an internal ``_t`` counter that is
+checkpointed/restored with the strategy state.
 """
 
 from __future__ import annotations

@@ -169,3 +169,83 @@ def test_gradient_noise_scale_positive():
     # small-batch norms larger than big-batch ⇒ positive noise scale
     out = gns.update([5.0, 5.0], [10, 10], aggregate_sq_norm=3.0, total_samples=20)
     assert out["server/gradient_noise_scale"] > 0
+
+
+# ---------------------------------------------------------------------------
+# round-3 golden additions: weighted, two distinct layers, all five
+# strategies against fully hand-computed values
+# ---------------------------------------------------------------------------
+
+
+def _two_layer_round(strategy, server=(4.0, -2.0)):
+    """One round, 2 clients with unequal weights, 2 distinct layers.
+
+    clients: c0 = (1, -1) with n=1;  c1 = (5, 3) with n=3
+    weighted avg = (1*1+5*3)/4 , (-1*1+3*3)/4 = (4.0, 2.0)
+    pseudo-grad g = x - avg = (0.0, -4.0)
+    """
+    strategy.initialize([np.full((2,), v, np.float32) for v in server])
+    results = (
+        ClientResult(
+            cid=i,
+            arrays=[np.full((2,), a, np.float32), np.full((2,), b, np.float32)],
+            n_samples=n,
+        )
+        for i, (a, b, n) in enumerate([(1.0, -1.0, 1), (5.0, 3.0, 3)])
+    )
+    params, _ = strategy.aggregate_fit(1, results)
+    return params[0][0], params[1][0]
+
+
+def test_golden_weighted_fedavg_two_layers():
+    s = FedAvgEff(server_learning_rate=0.5)
+    l0, l1 = _two_layer_round(s)
+    # x - 0.5*g: 4 - 0 = 4 ; -2 - 0.5*(-4) = 0
+    np.testing.assert_allclose((l0, l1), (4.0, 0.0), rtol=1e-6)
+
+
+def test_golden_weighted_nesterov_two_layers():
+    s = FedNesterov(server_learning_rate=1.0, server_momentum=0.5)
+    l0, l1 = _two_layer_round(s)
+    # m = 0.5*0 + g = g; step = g + 0.5*g = 1.5g: (0, -6); x - step = (4, 4)
+    np.testing.assert_allclose((l0, l1), (4.0, 4.0), rtol=1e-6)
+
+
+def test_golden_weighted_fedmom_two_layers():
+    s = FedMom(server_learning_rate=1.0, server_momentum=0.9)
+    l0, l1 = _two_layer_round(s)
+    # m = g; x - m = (4-0, -2-(-4)) = (4, 2)
+    np.testing.assert_allclose((l0, l1), (4.0, 2.0), rtol=1e-6)
+
+
+def test_golden_weighted_fedadam_two_layers():
+    # t=1 bias correction cancels: m̂=g, v̂=g²; step = 0.1·g/(|g|+τ) ≈ 0.1·sign(g)
+    # (τ>0 keeps the g=0 layer at exactly 0/τ = 0)
+    s = FedAdam(server_learning_rate=0.1, server_beta_1=0.9, server_beta_2=0.99, server_tau=1e-9)
+    l0, l1 = _two_layer_round(s)
+    np.testing.assert_allclose(l0, 4.0, atol=1e-6)        # g=0: no movement
+    np.testing.assert_allclose(l1, -2.0 + 0.1, rtol=1e-5)  # DESCENT: -η·sign(g)= +0.1
+    # the sign decision (divergence note in strategy/optimizers.py): the step
+    # moves TOWARD the client average (avg=2 > x=-2), unlike the reference's +g
+
+
+def test_golden_weighted_fedyogi_two_layers():
+    s = FedYogi(server_learning_rate=0.1, server_beta_1=0.9, server_beta_2=0.99, server_tau=1e-9)
+    l0, l1 = _two_layer_round(s)
+    # first step: v=(1-b2)g²·sign(g²-0)=(1-b2)g² == adam's first step
+    np.testing.assert_allclose(l0, 4.0, atol=1e-6)
+    np.testing.assert_allclose(l1, -2.0 + 0.1, rtol=1e-5)
+
+
+def test_adaptive_descends_toward_client_average():
+    """The sign decision, behaviorally: repeated rounds with clients pinned at
+    avg=2 must move the server params toward 2, not away (the reference's
+    ``x + η·…`` on ``g = x − avg`` walks away; see strategy/optimizers.py)."""
+    for cls in (FedAdam, FedYogi):
+        s = cls(server_learning_rate=0.5, server_tau=1e-9)
+        s.initialize(arrs(-2.0))
+        dist0 = abs(-2.0 - 2.0)
+        v = -2.0
+        for rnd in range(1, 6):
+            v, _ = _round(s, [2.0, 2.0], rnd=rnd)
+        assert abs(v - 2.0) < dist0, f"{cls.__name__} moved away from the client average"
