@@ -135,6 +135,9 @@ class TrainConfig:
     # (reference: ``device_train_microbatch_size: auto``,
     # ``photon/clients/trainer_utils.py:972-978``, ``mpt-125m.yaml:80-81``)
     device_microbatch_size: int | str = 8
+    # first candidate for the "auto" probe (0 = start at the full per-device
+    # batch); capping skips compiles of hopelessly large candidates
+    auto_microbatch_cap: int = 0
     # tokens per chunk of the scanned cross-entropy (0 = materialize full
     # logits); chunking keeps the fp32 [N, vocab] logits out of HBM
     loss_chunk_tokens: int = 2048

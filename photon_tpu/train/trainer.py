@@ -149,6 +149,8 @@ class Trainer:
 
         cfg = self.cfg
         per_device_rows = max(1, cfg.train.global_batch_size // dp_degree)
+        if cfg.train.auto_microbatch_cap:
+            per_device_rows = min(per_device_rows, cfg.train.auto_microbatch_cap)
         cand = 1 << (per_device_rows.bit_length() - 1)  # largest pow2 <= rows
         seq = cfg.model.max_seq_len
         last_err: Exception | None = None
