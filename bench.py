@@ -187,7 +187,7 @@ def supervise() -> int:
 # ---------------------------------------------------------------------------
 
 
-def _parity_shape(b: int, s: int, h: int, d: int, causal: bool) -> dict:
+def _parity_shape(b: int, s: int, h: int, d: int, causal: bool, alibi: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -206,9 +206,9 @@ def _parity_shape(b: int, s: int, h: int, d: int, causal: bool) -> dict:
         return float(jnp.linalg.norm(a - ref) / (jnp.linalg.norm(ref) + 1e-12))
 
     res: dict = {"shape": {"batch": b, "seq": s, "heads": h, "d_head": d,
-                           "causal": causal, "dtype": "bfloat16"}}
-    o_p = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=causal))(q, k, v)
-    o_x = jax.jit(lambda q, k, v: xla_attention(q, k, v, causal=causal))(q, k, v)
+                           "causal": causal, "alibi": alibi, "dtype": "bfloat16"}}
+    o_p = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=causal, alibi=alibi))(q, k, v)
+    o_x = jax.jit(lambda q, k, v: xla_attention(q, k, v, causal=causal, alibi=alibi))(q, k, v)
     res["fwd_rel_err"] = rel(o_p, o_x)
 
     def loss(fn):
@@ -216,8 +216,8 @@ def _parity_shape(b: int, s: int, h: int, d: int, causal: bool) -> dict:
             lambda q, k, v: (fn(q, k, v).astype(jnp.float32) * w).sum(), argnums=(0, 1, 2)
         ))
 
-    gp = loss(lambda q, k, v: flash_attention(q, k, v, causal=causal))(q, k, v)
-    gx = loss(lambda q, k, v: xla_attention(q, k, v, causal=causal))(q, k, v)
+    gp = loss(lambda q, k, v: flash_attention(q, k, v, causal=causal, alibi=alibi))(q, k, v)
+    gx = loss(lambda q, k, v: xla_attention(q, k, v, causal=causal, alibi=alibi))(q, k, v)
     for name, a, ref in zip(("dq", "dk", "dv"), gp, gx):
         res[f"bwd_{name}_rel_err"] = rel(a, ref)
     res["ok"] = all(
@@ -269,13 +269,14 @@ def kernel_parity(full: bool = True) -> dict:
 
     if full:
         extras = {
-            "d_head_128_1b_shape": (1, 1024, 8, 128, True),
-            "non_causal": (1, 1024, 8, 64, False),
-            "lane_padded_d80": (1, 1024, 8, 80, True),
+            "d_head_128_1b_shape": (1, 1024, 8, 128, True, False),
+            "non_causal": (1, 1024, 8, 64, False, False),
+            "lane_padded_d80": (1, 1024, 8, 80, True, False),
+            "alibi_in_kernel": (1, 1024, 8, 64, True, True),
         }
         res["extra_shapes"] = {}
-        for name, (b, s, h, d, causal) in extras.items():
-            sub = _parity_shape(b, s, h, d, causal)
+        for name, (b, s, h, d, causal, alibi) in extras.items():
+            sub = _parity_shape(b, s, h, d, causal, alibi)
             res["extra_shapes"][name] = sub
             res["ok"] = res["ok"] and sub["ok"]
 

@@ -84,8 +84,10 @@ def multihead_attention(
     """Dispatch on ``impl`` ∈ {pallas, xla, ring}. Falls back to XLA off-TPU;
     ``ring`` = context parallelism over the ambient mesh's ``sequence`` axis
     (``photon_tpu/ops/ring_attention.py``), degrading to pallas/xla when the
-    axis is trivial. ALiBi currently runs on the XLA/ring paths (the Pallas
-    kernel dispatches to XLA when ``alibi`` until the bias lands in-kernel)."""
+    axis is trivial. ALiBi runs in-kernel on the pallas path (per-head slope
+    bias, ``flash_attention.py:_alibi_bias``); the ring path's pallas inner
+    kernel still degrades to XLA under alibi (the lse-merge bwd oracle does
+    not model the bias yet)."""
     if impl == "ring":
         from photon_tpu.ops.flash_attention import pallas_supported
         from photon_tpu.ops.ring_attention import ring_attention
@@ -96,13 +98,11 @@ def multihead_attention(
         if mesh is not None and mesh.shape.get("sequence", 1) > 1:
             return ring_attention(q, k, v, mesh, causal=causal, impl=inner, alibi=alibi)
         impl = inner
-    if impl == "pallas" and not alibi:
+    if impl == "pallas":
         from photon_tpu.ops.flash_attention import flash_attention, pallas_supported
 
         if pallas_supported(q):
-            return flash_attention(q, k, v, causal=causal)
-        impl = "xla"
-    elif impl == "pallas":
+            return flash_attention(q, k, v, causal=causal, alibi=alibi)
         impl = "xla"
     if impl != "xla":
         raise ValueError(f"unknown attention impl {impl!r}")

@@ -48,15 +48,38 @@ def pallas_supported(x: jax.Array) -> bool:
     return platform == "tpu"
 
 
-def _causal_mask(q_blk: int, k_blk: int, block_q: int, block_k: int, offset: int) -> jax.Array:
-    """Boolean [block_q, block_k] mask for the (q_blk, k_blk) tile.
+def _tile_ids(q_blk: int, k_blk: int, block_q: int, block_k: int, offset: int):
+    """Global (query, key) position iotas for the (q_blk, k_blk) tile.
 
     ``offset = s_k - s_q`` aligns query positions to the end of the key
     sequence (matches ``xla_attention``; matters when s_q != s_k).
     """
     q_ids = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + q_blk * block_q + offset
     k_ids = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + k_blk * block_k
+    return q_ids, k_ids
+
+
+def _causal_mask(q_blk: int, k_blk: int, block_q: int, block_k: int, offset: int) -> jax.Array:
+    """Boolean [block_q, block_k] mask for the (q_blk, k_blk) tile."""
+    q_ids, k_ids = _tile_ids(q_blk, k_blk, block_q, block_k, offset)
     return q_ids >= k_ids
+
+
+def _alibi_bias(slope, q_blk, k_blk, block_q, block_k, offset) -> jax.Array:
+    """Per-head ALiBi bias ``-slope * (q_pos - k_pos)`` for one tile
+    (reference: llm-foundry MPT ``attn_config.alibi``; oracle:
+    ``ops/attention.py:xla_attention``)."""
+    q_ids, k_ids = _tile_ids(q_blk, k_blk, block_q, block_k, offset)
+    return -slope * (q_ids - k_ids).astype(jnp.float32)
+
+
+def _bh_slopes(alibi_h: int, bh: int) -> jax.Array:
+    """[bh, SUBLANE, LANE] per-(batch*head) slope array (replicated across
+    the tile so each grid row DMAs one full fp32 tile)."""
+    from photon_tpu.ops.attention import alibi_slopes
+
+    slopes = jnp.tile(alibi_slopes(alibi_h), bh // alibi_h)  # head-major order
+    return jnp.broadcast_to(slopes[:, None, None], (bh, SUBLANE, LANE))
 
 
 # ---------------------------------------------------------------------------
@@ -64,7 +87,12 @@ def _causal_mask(q_blk: int, k_blk: int, block_q: int, block_k: int, offset: int
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, scale, block_q, block_k, causal, offset):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, block_q, block_k, causal, offset, use_alibi):
+    if use_alibi:
+        slopes_ref, o_ref, lse_ref, m_s, l_s, acc_s = rest
+    else:
+        slopes_ref = None
+        o_ref, lse_ref, m_s, l_s, acc_s = rest
     q_blk = pl.program_id(1)
     k_blk = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -85,6 +113,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, scale, 
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
         s = s * scale
+        if use_alibi:
+            s = s + _alibi_bias(slopes_ref[0, 0, 0], q_blk, k_blk, block_q, block_k, offset)
         if causal:
             s = jnp.where(_causal_mask(q_blk, k_blk, block_q, block_k, offset), s, NEG_INF)
 
@@ -122,7 +152,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, scale, 
         lse_ref[0] = jnp.broadcast_to(lse[None, :], (SUBLANE, lse.shape[0]))
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_k, offset=None):
+def _fwd(q, k, v, *, scale, causal, block_q, block_k, offset=None, alibi_h=0, interpret=False):
     bh, s_q, d = q.shape
     s_k = k.shape[1]
     n_q = pl.cdiv(s_q, block_q)
@@ -134,8 +164,18 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, offset=None):
     # q_start - k_start; default aligns q to the end of k)
     offset = s_k - s_q if offset is None else offset
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k, causal=causal, offset=offset
+        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k, causal=causal,
+        offset=offset, use_alibi=bool(alibi_h),
     )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    inputs = [q, k, v]
+    if alibi_h:
+        in_specs.append(pl.BlockSpec((1, SUBLANE, LANE), lambda b, i, j: (b, 0, 0)))
+        inputs.append(_bh_slopes(alibi_h, bh))
     # lse carries SUBLANE redundant rows so its (1, 8, block_q) blocks are
     # exactly one fp32 tile; callers use row 0
     out_shape = [
@@ -145,11 +185,7 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, offset=None):
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, SUBLANE, block_q), lambda b, i, j: (b, 0, i)),
@@ -160,7 +196,8 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, offset=None):
             pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
         ],
         out_shape=out_shape,
-    )(q, k, v)
+        interpret=interpret,
+    )(*inputs)
     return o, lse[:, 0, :]
 
 
@@ -169,7 +206,12 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, offset=None):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s, *, scale, block_q, block_k, causal, offset):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest, scale, block_q, block_k, causal, offset, use_alibi):
+    if use_alibi:
+        slopes_ref, dq_ref, dq_s = rest
+    else:
+        slopes_ref = None
+        dq_ref, dq_s = rest
     q_blk = pl.program_id(1)
     k_blk = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -184,6 +226,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
         q = q_ref[0]
         k = k_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+        if use_alibi:
+            s = s + _alibi_bias(slopes_ref[0, 0, 0], q_blk, k_blk, block_q, block_k, offset)
         if causal:
             s = jnp.where(_causal_mask(q_blk, k_blk, block_q, block_k, offset), s, NEG_INF)
         lse = lse_ref[0, 0][:, None]
@@ -211,7 +255,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
         dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_s, dv_s, *, scale, block_q, block_k, causal, offset):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest, scale, block_q, block_k, causal, offset, use_alibi):
+    if use_alibi:
+        slopes_ref, dk_ref, dv_ref, dk_s, dv_s = rest
+    else:
+        slopes_ref = None
+        dk_ref, dv_ref, dk_s, dv_s = rest
     k_blk = pl.program_id(1)
     q_blk = pl.program_id(2)
     n_q = pl.num_programs(2)
@@ -227,6 +276,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         q = q_ref[0]
         k = k_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+        if use_alibi:
+            s = s + _alibi_bias(slopes_ref[0, 0, 0], q_blk, k_blk, block_q, block_k, offset)
         if causal:
             s = jnp.where(_causal_mask(q_blk, k_blk, block_q, block_k, offset), s, NEG_INF)
         lse = lse_ref[0, 0][:, None]
@@ -260,7 +311,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, res, do):
+def _bwd(scale, causal, block_q, block_k, res, do, *, alibi_h=0, interpret=False):
     q, k, v, o, lse = res
     bh, s_q, d = q.shape
     s_k = k.shape[1]
@@ -272,8 +323,15 @@ def _bwd(scale, causal, block_q, block_k, res, do):
     lse_b = jnp.broadcast_to(lse[:, None, :], (bh, SUBLANE, s_q))
     delta_b = jnp.broadcast_to(delta[:, None, :], (bh, SUBLANE, s_q))
 
+    use_alibi = bool(alibi_h)
+    extra_inputs = [_bh_slopes(alibi_h, bh)] if use_alibi else []
+    slope_spec = (
+        [pl.BlockSpec((1, SUBLANE, LANE), lambda b, i, j: (b, 0, 0))] if use_alibi else []
+    )
+
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k, causal=causal, offset=s_k - s_q),
+        functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
+                          causal=causal, offset=s_k - s_q, use_alibi=use_alibi),
         grid=(bh, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # q
@@ -282,14 +340,16 @@ def _bwd(scale, causal, block_q, block_k, res, do):
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # do
             pl.BlockSpec((1, SUBLANE, block_q), lambda b, i, j: (b, 0, i)),  # lse
             pl.BlockSpec((1, SUBLANE, block_q), lambda b, i, j: (b, 0, i)),  # delta
-        ],
+        ] + slope_spec,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
-    )(q, k, v, do, lse_b, delta_b)
+        interpret=interpret,
+    )(q, k, v, do, lse_b, delta_b, *extra_inputs)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k, causal=causal, offset=s_k - s_q),
+        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
+                          causal=causal, offset=s_k - s_q, use_alibi=use_alibi),
         grid=(bh, n_k, n_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # q
@@ -298,7 +358,7 @@ def _bwd(scale, causal, block_q, block_k, res, do):
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # do
             pl.BlockSpec((1, SUBLANE, block_q), lambda b, j, i: (b, 0, i)),  # lse
             pl.BlockSpec((1, SUBLANE, block_q), lambda b, j, i: (b, 0, i)),  # delta
-        ],
+        ] + slope_spec,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -311,7 +371,8 @@ def _bwd(scale, causal, block_q, block_k, res, do):
             jax.ShapeDtypeStruct((bh, s_k, d), k.dtype),
             jax.ShapeDtypeStruct((bh, s_k, d), v.dtype),
         ],
-    )(q, k, v, do, lse_b, delta_b)
+        interpret=interpret,
+    )(q, k, v, do, lse_b, delta_b, *extra_inputs)
 
     return dq, dk, dv
 
@@ -321,19 +382,21 @@ def _bwd(scale, causal, block_q, block_k, res, do):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, block_k):
-    o, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k, alibi_h, interpret):
+    o, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+                alibi_h=alibi_h, interpret=interpret)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
-    o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, alibi_h, interpret):
+    o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+                  alibi_h=alibi_h, interpret=interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, res, do):
-    return _bwd(scale, causal, block_q, block_k, res, do)
+def _flash_bwd(scale, causal, block_q, block_k, alibi_h, interpret, res, do):
+    return _bwd(scale, causal, block_q, block_k, res, do, alibi_h=alibi_h, interpret=interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -345,10 +408,16 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = True,
+    alibi: bool = False,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
 ) -> jax.Array:
-    """Flash attention over ``[batch, seq, heads, d_head]`` inputs."""
+    """Flash attention over ``[batch, seq, heads, d_head]`` inputs.
+
+    ``alibi`` adds the per-head linear distance bias in-kernel (slopes are
+    static per head count — ``ops/attention.py:alibi_slopes``).
+    ``interpret`` runs the kernel in the Pallas interpreter (CPU-testable)."""
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     block_q = min(block_q, s_q)
@@ -366,7 +435,7 @@ def flash_attention(
         return x
 
     qb, kb, vb = to_bh(q, s_q), to_bh(k, s_k), to_bh(v, s_k)
-    ob = _flash(qb, kb, vb, scale, causal, block_q, block_k)
+    ob = _flash(qb, kb, vb, scale, causal, block_q, block_k, h if alibi else 0, interpret)
     o = ob[..., :d].reshape(b, h, s_q, d)
     return jnp.transpose(o, (0, 2, 1, 3))
 
@@ -376,17 +445,19 @@ def flash_attention(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_lse(q, k, v, scale, causal, offset, block_q, block_k):
-    return _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k, offset=offset)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, scale, causal, offset, block_q, block_k, interpret=False):
+    return _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+                offset=offset, interpret=interpret)
 
 
-def _flash_lse_fwd(q, k, v, scale, causal, offset, block_q, block_k):
-    o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k, offset=offset)
+def _flash_lse_fwd(q, k, v, scale, causal, offset, block_q, block_k, interpret=False):
+    o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+                  offset=offset, interpret=interpret)
     return (o, lse), (q, k, v)
 
 
-def _flash_lse_bwd(scale, causal, offset, block_q, block_k, res, cots):
+def _flash_lse_bwd(scale, causal, offset, block_q, block_k, interpret, res, cots):
     """Exact backward for BOTH outputs (o, lse) by recomputing the chunk with
     the differentiable XLA path. Ring attention's online-softmax merge takes
     real gradients through lse, which the FlashAttention-2 backward (defined
@@ -421,6 +492,7 @@ def flash_attention_with_lse(
     k_start: int = 0,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Like :func:`flash_attention` but over global positions
     (``q_start``/``k_start`` are the chunks' sequence offsets) and returning
@@ -441,6 +513,6 @@ def flash_attention_with_lse(
         return x
 
     qb, kb, vb = to_bh(q, s_q), to_bh(k, s_k), to_bh(v, s_k)
-    ob, lse = _flash_lse(qb, kb, vb, scale, causal, q_start - k_start, block_q, block_k)
+    ob, lse = _flash_lse(qb, kb, vb, scale, causal, q_start - k_start, block_q, block_k, interpret)
     o = jnp.transpose(ob[..., :d].reshape(b, h, s_q, d), (0, 2, 1, 3))
     return o, jnp.transpose(lse.reshape(b, h, s_q), (0, 2, 1))
