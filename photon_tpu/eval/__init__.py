@@ -1,6 +1,15 @@
 """Evaluation harnesses: ICL gauntlet (reference: llm-foundry Eval Gauntlet
 via ``conf/icl_tasks_config`` / ``conf/eval_gauntlet_config``)."""
 
+from photon_tpu.eval.gauntlet import GauntletConfig, TaskSuite, run_gauntlet_suite
 from photon_tpu.eval.icl import ICLTask, evaluate_task, make_logprob_fn, run_gauntlet
 
-__all__ = ["ICLTask", "evaluate_task", "make_logprob_fn", "run_gauntlet"]
+__all__ = [
+    "GauntletConfig",
+    "ICLTask",
+    "TaskSuite",
+    "evaluate_task",
+    "make_logprob_fn",
+    "run_gauntlet",
+    "run_gauntlet_suite",
+]

@@ -60,6 +60,12 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--split", default="val")
     ap.add_argument("--eval-batches", type=int, default=16)
     ap.add_argument("--icl-tasks", nargs="*", default=[], help="jsonl task files/globs")
+    ap.add_argument("--tasks-yaml", default=None,
+                    help="icl_tasks suite YAML (reference tasks_v0.3.yaml format)")
+    ap.add_argument("--gauntlet-yaml", default=None,
+                    help="eval_gauntlet YAML (categories/weights/baselines)")
+    ap.add_argument("--tasks-root", default=None,
+                    help="root_dir for dataset_uri resolution (default: suite YAML's)")
     ap.add_argument("--icl-max-rows", type=int, default=None)
     ap.add_argument("--tokenizer", default="byte-fallback")
     args = ap.parse_args(argv)
@@ -91,6 +97,24 @@ def main(argv: list[str] | None = None) -> None:
         )
         batches = [next(loader) for _ in range(args.eval_batches)]
         out.update(trainer.evaluate(batches))
+
+    if args.tasks_yaml:
+        from photon_tpu.data.tokenizer import load_tokenizer
+        from photon_tpu.eval.gauntlet import run_gauntlet_suite
+
+        tok = load_tokenizer(args.tokenizer)
+
+        def apply(p, tokens):
+            return model.apply({"params": p}, tokens)
+
+        out.update(
+            run_gauntlet_suite(
+                args.tasks_yaml, args.gauntlet_yaml, tok, apply, params,
+                root_dir=args.tasks_root,
+                seq_len=min(cfg.model.max_seq_len, 512),
+                max_rows=args.icl_max_rows,
+            )
+        )
 
     if args.icl_tasks:
         from photon_tpu.data.tokenizer import load_tokenizer

@@ -1,0 +1,244 @@
+"""Eval Gauntlet: YAML task suites + category-weighted score aggregation.
+
+Reference formats (parsed compatibly):
+- Task suite — ``photon/conf/icl_tasks_config/tasks_v0.3.yaml``: an
+  ``icl_tasks`` list of ``{label, dataset_uri, num_fewshot, icl_task_type,
+  continuation_delimiter, question_prelimiter, ...}`` entries resolved
+  against a ``root_dir``.
+- Gauntlet — ``photon/conf/eval_gauntlet_config/eval_gauntlet_v0.3.yaml``:
+  ``eval_gauntlet.categories[].benchmarks[]`` with ``num_fewshot`` and
+  ``random_baseline``, plus ``weighting``, ``subtract_random_baseline``,
+  ``rescale_accuracy`` and named ``averages`` over category lists.
+
+Scope: ``multiple_choice`` and ``language_modeling`` task types score
+through the jitted continuation-logprob path (``icl.py``).
+``generation_task_with_answers`` entries (gsm8k-style, requiring sampling)
+are reported as skipped — the harness is logprob-based.
+
+A small format-faithful demo corpus ships under ``eval/local_data`` with
+``configs/tasks_demo.yaml`` + ``configs/gauntlet_demo.yaml`` so the pipeline
+runs end to end out of the box; point ``root_dir`` at an llm-foundry
+``local_data`` checkout to run the real v0.3 suite.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+from typing import Any, Callable, Iterable
+
+import numpy as np
+import yaml
+
+from photon_tpu.eval.icl import ICLTask, evaluate_task, make_logprob_fn
+
+_SCOREABLE = {"multiple_choice", "language_modeling"}
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    label: str
+    dataset_uri: str
+    icl_task_type: str
+    num_fewshot: tuple[int, ...] = (0,)
+    continuation_delimiter: str = " "
+    question_prelimiter: str = ""
+    example_delimiter: str = "\n"
+
+    @property
+    def scoreable(self) -> bool:
+        return self.icl_task_type in _SCOREABLE
+
+
+@dataclasses.dataclass
+class TaskSuite:
+    """Parsed ``icl_tasks`` suite (reference ``tasks_v0.3.yaml``)."""
+
+    specs: list[TaskSpec]
+    root_dir: pathlib.Path
+
+    @classmethod
+    def from_yaml(cls, path: str | pathlib.Path, root_dir: str | None = None) -> "TaskSuite":
+        p = pathlib.Path(path)
+        doc = yaml.safe_load(p.read_text()) or {}
+        entries = doc.get("icl_tasks")
+        if not isinstance(entries, list):
+            raise ValueError(f"{p}: expected a top-level 'icl_tasks' list")
+        root = pathlib.Path(root_dir or doc.get("root_dir") or p.parent)
+        specs = []
+        for e in entries:
+            fewshot = e.get("num_fewshot", [0])
+            if isinstance(fewshot, int):
+                fewshot = [fewshot]
+            specs.append(
+                TaskSpec(
+                    label=str(e["label"]),
+                    dataset_uri=str(e["dataset_uri"]),
+                    icl_task_type=str(e.get("icl_task_type", "multiple_choice")),
+                    num_fewshot=tuple(int(f) for f in fewshot),
+                    continuation_delimiter=str(e.get("continuation_delimiter", " ")),
+                    question_prelimiter=str(e.get("question_prelimiter", "")),
+                    example_delimiter=str(e.get("example_delimiter", "\n")),
+                )
+            )
+        return cls(specs, root)
+
+    def load_tasks(
+        self, labels_fewshot: dict[str, int] | None = None
+    ) -> tuple[list[ICLTask], list[str]]:
+        """Materialize jsonl-backed :class:`ICLTask`s.
+
+        ``labels_fewshot`` (from a gauntlet config) filters to those labels
+        and pins each one's fewshot count; without it every scoreable spec
+        loads at its first ``num_fewshot``. Returns ``(tasks, skipped)``.
+        """
+        tasks: list[ICLTask] = []
+        skipped: list[str] = []
+        for spec in self.specs:
+            if labels_fewshot is not None and spec.label not in labels_fewshot:
+                continue
+            if not spec.scoreable:
+                skipped.append(f"{spec.label} ({spec.icl_task_type})")
+                continue
+            fewshot = (
+                labels_fewshot[spec.label] if labels_fewshot is not None
+                else spec.num_fewshot[0]
+            )
+            path = self.root_dir / spec.dataset_uri
+            task = ICLTask.from_jsonl(
+                path,
+                name=spec.label,
+                num_fewshot=fewshot,
+                continuation_delimiter=spec.continuation_delimiter,
+                question_prelimiter=spec.question_prelimiter,
+                example_delimiter=spec.example_delimiter,
+            )
+            if task.kind != spec.icl_task_type:
+                raise ValueError(
+                    f"{spec.label}: yaml says {spec.icl_task_type} but "
+                    f"{path} rows look like {task.kind}"
+                )
+            tasks.append(task)
+        return tasks, skipped
+
+
+@dataclasses.dataclass
+class Benchmark:
+    name: str
+    num_fewshot: int = 0
+    random_baseline: float = 0.0
+    scale: float = 1.0  # per-benchmark weight under non-EQUAL weighting
+
+
+@dataclasses.dataclass
+class GauntletConfig:
+    """Parsed ``eval_gauntlet`` block (reference ``eval_gauntlet_v0.3.yaml``)."""
+
+    categories: dict[str, list[Benchmark]]
+    weighting: str = "EQUAL"
+    subtract_random_baseline: bool = True
+    rescale_accuracy: bool = True
+    averages: dict[str, list[str]] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_yaml(cls, path: str | pathlib.Path) -> "GauntletConfig":
+        doc = yaml.safe_load(pathlib.Path(path).read_text()) or {}
+        g = doc.get("eval_gauntlet", doc)
+        cats: dict[str, list[Benchmark]] = {}
+        for cat in g.get("categories", []):
+            cats[str(cat["name"])] = [
+                Benchmark(
+                    name=str(b["name"]),
+                    num_fewshot=int(b.get("num_fewshot", 0)),
+                    random_baseline=float(b.get("random_baseline", 0.0)),
+                    scale=float(b.get("scale", 1.0)),
+                )
+                for b in cat.get("benchmarks", [])
+            ]
+        if not cats:
+            raise ValueError(f"{path}: no categories in eval_gauntlet config")
+        return cls(
+            categories=cats,
+            weighting=str(g.get("weighting", "EQUAL")),
+            subtract_random_baseline=bool(g.get("subtract_random_baseline", True)),
+            rescale_accuracy=bool(g.get("rescale_accuracy", True)),
+            averages={
+                str(k): [str(c) for c in v]
+                for k, v in (g.get("averages") or {}).items()
+            },
+        )
+
+    def labels_fewshot(self) -> dict[str, int]:
+        return {b.name: b.num_fewshot for bs in self.categories.values() for b in bs}
+
+    # -- scoring -----------------------------------------------------------
+    def adjust(self, raw: float, baseline: float) -> float:
+        """Baseline subtraction + rescale (reference gauntlet averaging)."""
+        score = raw
+        if self.subtract_random_baseline:
+            score = score - baseline
+        if self.rescale_accuracy and self.subtract_random_baseline:
+            score = score / max(1.0 - baseline, 1e-9)
+        return max(score, 0.0)
+
+    def aggregate(self, raw_scores: dict[str, float]) -> dict[str, float]:
+        """raw per-benchmark scores → adjusted benchmarks, category means,
+        named averages, and an overall mean of categories."""
+        out: dict[str, float] = {}
+        cat_means: dict[str, float] = {}
+        for cat, benches in self.categories.items():
+            vals, weights = [], []
+            for b in benches:
+                if b.name not in raw_scores:
+                    continue
+                out[f"gauntlet/{cat}/{b.name}"] = adj = self.adjust(
+                    raw_scores[b.name], b.random_baseline
+                )
+                vals.append(adj)
+                weights.append(1.0 if self.weighting == "EQUAL" else b.scale)
+            if vals:
+                cat_means[cat] = float(np.average(vals, weights=weights))
+                out[f"gauntlet/category/{cat}"] = cat_means[cat]
+        for avg_name, cat_list in self.averages.items():
+            present = [cat_means[c] for c in cat_list if c in cat_means]
+            if present:
+                out[f"gauntlet/{avg_name}"] = float(np.mean(present))
+        if cat_means:
+            out["gauntlet/average"] = float(np.mean(list(cat_means.values())))
+        return out
+
+
+def run_gauntlet_suite(
+    tasks_yaml: str | pathlib.Path,
+    gauntlet_yaml: str | pathlib.Path | None,
+    tokenizer,
+    model_apply: Callable,
+    params: Any,
+    *,
+    root_dir: str | None = None,
+    seq_len: int = 256,
+    batch_size: int = 16,
+    max_rows: int | None = None,
+) -> dict[str, float]:
+    """YAML-driven gauntlet run: suite → tasks → raw scores → weighted
+    category averages (the ``eval_gauntlet_only.sh`` analog)."""
+    suite = TaskSuite.from_yaml(tasks_yaml, root_dir=root_dir)
+    gauntlet = GauntletConfig.from_yaml(gauntlet_yaml) if gauntlet_yaml else None
+    labels = gauntlet.labels_fewshot() if gauntlet else None
+    tasks, skipped = suite.load_tasks(labels)
+    if not tasks:
+        raise ValueError(f"no scoreable tasks loaded from {tasks_yaml}")
+
+    logprob_fn = make_logprob_fn(model_apply, params, seq_len)
+    raw: dict[str, float] = {}
+    out: dict[str, float] = {}
+    for task in tasks:
+        res = evaluate_task(task, tokenizer, logprob_fn, seq_len, batch_size, max_rows=max_rows)
+        metric = "accuracy" if task.kind == "multiple_choice" else "logprob_per_token"
+        raw[task.name] = res[metric]
+        out[f"icl/{task.name}/{metric}"] = res[metric]
+    if gauntlet:
+        out.update(gauntlet.aggregate(raw))
+    if skipped:
+        out["gauntlet/skipped_tasks"] = float(len(skipped))
+    return out

@@ -1,0 +1,169 @@
+"""Gauntlet YAML suite tests: parsing both reference formats, fewshot prompt
+assembly, batched MC scoring across rows, category aggregation with
+baseline subtraction + rescale, and the end-to-end demo corpus run."""
+
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.tokenizer import ByteTokenizer
+from photon_tpu.eval.gauntlet import GauntletConfig, TaskSuite, run_gauntlet_suite
+from photon_tpu.eval.icl import ICLTask, evaluate_task, make_logprob_fn
+
+VOCAB = 257
+SEQ = 64
+CONFIGS = pathlib.Path("photon_tpu/eval/configs")
+
+
+def _apply(params, tokens):
+    """Deterministic fake model: next byte = current + 1 (jit-traceable)."""
+    nxt = (tokens + 1) % VOCAB
+    return 20.0 * jax.nn.one_hot(nxt, VOCAB, dtype=jnp.float32) - 10.0
+
+
+# -- YAML parsing -----------------------------------------------------------
+
+
+def test_parse_reference_task_suite_format():
+    suite = TaskSuite.from_yaml(CONFIGS / "tasks_demo.yaml")
+    labels = {s.label for s in suite.specs}
+    assert labels == {"arc_demo", "copa_demo", "lambada_demo", "gsm_demo"}
+    arc = next(s for s in suite.specs if s.label == "arc_demo")
+    assert arc.icl_task_type == "multiple_choice"
+    assert arc.num_fewshot == (2,)
+    assert arc.continuation_delimiter == "\nAnswer: "
+    gsm = next(s for s in suite.specs if s.label == "gsm_demo")
+    assert not gsm.scoreable  # generation tasks are out of logprob scope
+
+
+def test_parse_reference_gauntlet_format():
+    g = GauntletConfig.from_yaml(CONFIGS / "gauntlet_demo.yaml")
+    assert g.weighting == "EQUAL"
+    assert g.subtract_random_baseline and g.rescale_accuracy
+    assert set(g.categories) == {
+        "world_knowledge", "commonsense_reasoning", "language_understanding",
+        "symbolic_problem_solving",
+    }
+    assert g.averages["core_average"] == [
+        "world_knowledge", "commonsense_reasoning", "language_understanding",
+        "symbolic_problem_solving",
+    ]
+    assert g.labels_fewshot() == {
+        "arc_demo": 2, "copa_demo": 0, "lambada_demo": 0, "gsm_demo": 0
+    }
+
+
+def test_suite_load_skips_generation_tasks():
+    suite = TaskSuite.from_yaml(CONFIGS / "tasks_demo.yaml")
+    tasks, skipped = suite.load_tasks()
+    assert {t.name for t in tasks} == {"arc_demo", "copa_demo", "lambada_demo"}
+    assert skipped == ["gsm_demo (generation_task_with_answers)"]
+
+
+def test_suite_type_mismatch_raises(tmp_path):
+    (tmp_path / "t.jsonl").write_text(json.dumps({"context": "a", "continuation": "b"}))
+    (tmp_path / "suite.yaml").write_text(
+        "icl_tasks:\n  - label: t\n    dataset_uri: t.jsonl\n"
+        "    icl_task_type: multiple_choice\n"
+    )
+    suite = TaskSuite.from_yaml(tmp_path / "suite.yaml")
+    with pytest.raises(ValueError, match="look like"):
+        suite.load_tasks()
+
+
+# -- fewshot + batched MC ---------------------------------------------------
+
+
+def test_fewshot_context_assembly():
+    rows = [
+        {"query": "q0", "choices": ["a", "b"], "gold": 0},
+        {"query": "q1", "choices": ["a", "b"], "gold": 1},
+        {"query": "q2", "choices": ["a", "b"], "gold": 0},
+    ]
+    task = ICLTask(
+        "t", "multiple_choice", rows, num_fewshot=2,
+        continuation_delimiter=": ", example_delimiter="\n",
+    )
+    ctx = task.build_context(1)  # scored row must be excluded from shots
+    assert ctx == "q0: a\nq2: a\nq1: "
+
+
+def test_batched_mc_matches_per_row_dispatch():
+    """Scoring across row boundaries in full batches must give the same
+    accuracy as the old one-batch-per-row dispatch (batch_size smaller than
+    a row's choice count would previously have raised)."""
+    tok = ByteTokenizer()
+    rows = [
+        {"query": "abcd", "choices": ["efgh", "zzzz", "qqqq"], "gold": 0},
+        {"query": "mnop", "choices": ["xxxx", "qrst", "aaaa"], "gold": 1},
+        {"query": "stuv", "choices": ["wxyz", "bbbb", "cccc"], "gold": 0},
+    ]
+    task = ICLTask("asc", "multiple_choice", rows)
+    fn = make_logprob_fn(_apply, None, SEQ)
+    # batch 2 < 3 choices: only possible with cross-row batching
+    res = evaluate_task(task, tok, fn, SEQ, batch_size=2)
+    assert res["accuracy"] == 1.0
+    res8 = evaluate_task(task, tok, fn, SEQ, batch_size=8)
+    assert res8["accuracy"] == 1.0
+
+
+# -- aggregation ------------------------------------------------------------
+
+
+def test_aggregate_subtract_and_rescale():
+    from photon_tpu.eval.gauntlet import Benchmark
+
+    g = GauntletConfig(
+        categories={"cat_a": [Benchmark("b1", 0, 0.25)]},
+    )
+    out = g.aggregate({"b1": 0.625})
+    # (0.625 - 0.25) / 0.75 = 0.5
+    assert out["gauntlet/cat_a/b1"] == pytest.approx(0.5)
+    assert out["gauntlet/category/cat_a"] == pytest.approx(0.5)
+    assert out["gauntlet/average"] == pytest.approx(0.5)
+
+
+def test_aggregate_named_averages_and_floor():
+    from photon_tpu.eval.gauntlet import Benchmark
+
+    g = GauntletConfig(
+        categories={
+            "good": [Benchmark("b1", 0, 0.5)],
+            "bad": [Benchmark("b2", 0, 0.5)],
+            "other": [Benchmark("b3", 0, 0.0)],
+        },
+        averages={"core": ["good", "bad"]},
+    )
+    out = g.aggregate({"b1": 1.0, "b2": 0.2, "b3": 0.4})
+    assert out["gauntlet/good/b1"] == pytest.approx(1.0)
+    assert out["gauntlet/bad/b2"] == 0.0  # below baseline: floored, not negative
+    assert out["gauntlet/core"] == pytest.approx(0.5)
+    assert out["gauntlet/average"] == pytest.approx((1.0 + 0.0 + 0.4) / 3)
+
+
+# -- end to end -------------------------------------------------------------
+
+
+def test_demo_corpus_end_to_end():
+    tok = ByteTokenizer()
+    out = run_gauntlet_suite(
+        CONFIGS / "tasks_demo.yaml",
+        CONFIGS / "gauntlet_demo.yaml",
+        tok, _apply, params=None, seq_len=128, batch_size=8,
+    )
+    # all three scoreable benchmarks produced raw + adjusted scores
+    for key in (
+        "icl/arc_demo/accuracy",
+        "icl/copa_demo/accuracy",
+        "icl/lambada_demo/logprob_per_token",
+        "gauntlet/category/world_knowledge",
+        "gauntlet/core_average",
+        "gauntlet/average",
+    ):
+        assert key in out, key
+    assert out["gauntlet/skipped_tasks"] == 1.0  # gsm_demo (generation)
+    assert 0.0 <= out["icl/arc_demo/accuracy"] <= 1.0
