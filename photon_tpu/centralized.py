@@ -61,8 +61,10 @@ def run_centralized(
     dump_params: bool = False,
 ) -> History:
     total_steps = total_steps if total_steps is not None else cfg.scheduler.t_max
+    # config knob is the default; the CLI flag overrides
+    eval_interval_steps = eval_interval_steps or cfg.train.eval_interval
     trainer = Trainer(cfg)
-    history = History(make_wandb_run(None, cfg.run_uuid))
+    history = History(make_wandb_run(cfg.wandb_project, cfg.run_uuid))
     store = FileStore(pathlib.Path(cfg.photon.save_path) / "store")
     ckpt = ClientCheckpointManager(store, cfg.run_uuid)
 

@@ -142,8 +142,10 @@ class TrainConfig:
     # logits); chunking keeps the fp32 [N, vocab] logits out of HBM
     loss_chunk_tokens: int = 2048
     seed: int = 17
-    precision: str = "amp_bf16"
-    eval_interval: int = 0  # 0 = no mid-training eval
+    # numerics are expressed by model.param_dtype/compute_dtype (fp32 params,
+    # bf16 compute = the reference's amp_bf16 + FSDP PURE); there is
+    # deliberately no separate "precision" string knob duplicating them
+    eval_interval: int = 0  # mid-training eval every N steps (0 = off)
     eval_batches: int = 8
     log_interval: int = 10
 
@@ -157,7 +159,9 @@ class DatasetConfig:
     split_eval: str = "val"
     shuffle: bool = True
     shuffle_seed: int = 17
-    num_canonical_nodes: int = 1
+    # (no num_canonical_nodes analog: the reference needs it to keep MDS data
+    # order invariant to physical node count; here every client cid owns its
+    # own resumable loader, so order is node-count-invariant by construction)
     synthetic: bool = False  # deterministic synthetic tokens (tests / no-data bench)
 
 
@@ -231,6 +235,9 @@ class Config:
 
     run_uuid: str = "dev"
     seed: int = 17
+    # wandb project (None = metrics stay local; reference: wandb block in
+    # BaseConfig). Per-client runs get a ``_client_{cid}`` name suffix.
+    wandb_project: str | None = None
     photon: PhotonConfig = field(default_factory=PhotonConfig)
     fl: FLConfig = field(default_factory=FLConfig)
     model: ModelConfig = field(default_factory=ModelConfig)

@@ -1,11 +1,14 @@
 """Deterministic, resumable streaming loader over PTS shards.
 
 Role parity with mosaicml-streaming's ``StreamingDataset`` as photon uses it
-(shuffle_seed / num_canonical_nodes / shuffle_block semantics,
+(shuffle_seed / shuffle_block semantics,
 ``photon/clients/llm_config_functions.py:532-606``): the global sample order
 for an epoch is a pure function of ``(seed, epoch)``, and the loader resumes
 from ``(epoch, sample_in_epoch)`` exactly — the property photon's
-``reset_dataset_state`` / client-timestamp bookkeeping depends on.
+``reset_dataset_state`` / client-timestamp bookkeeping depends on. (The
+reference's ``num_canonical_nodes`` — order invariance under physical node
+count — has no analog here: every client cid owns its own loader, so order
+is node-count-invariant by construction.)
 
 Shuffle model (block shuffle, MDS-like): the shard list is permuted, then
 samples are shuffled inside fixed-size blocks of the concatenated permuted

@@ -61,8 +61,23 @@ class ClientRuntime:
         self.ckpt_mgr = ckpt_mgr
         self.trainer = Trainer(cfg)
         self._loaders: dict[tuple[int, str], StreamingLoader] = {}
+        self._histories: dict[int, Any] = {}  # per-cid metric history
         self._current_params: tuple[ParamsMetadata, list[np.ndarray]] | None = None
         self._personal: dict[int, list[np.ndarray]] = {}  # per-cid personalized layers
+
+    def _history(self, cid: int):
+        """Per-cid metric history; wandb runs (when configured) are named
+        ``{run_uuid}_client_{cid}`` (reference: per-client run naming,
+        ``photon/clients/llm_config_functions.py:767-862``)."""
+        if cid not in self._histories:
+            from photon_tpu.metrics.history import History, client_run_name, make_wandb_run
+
+            self._histories[cid] = History(
+                make_wandb_run(
+                    self.cfg.wandb_project, client_run_name(self.cfg.run_uuid, cid)
+                )
+            )
+        return self._histories[cid]
 
     # -- data ------------------------------------------------------------
     def _loader(self, cid: int, split: str, batch_size: int) -> StreamingLoader:
@@ -246,6 +261,7 @@ class ClientRuntime:
         )
         metrics = dict(metrics)
         metrics["node_training_time_s"] = wall
+        self._history(cid).record(ins.server_round, metrics)
         return FitRes(
             server_round=ins.server_round,
             cid=cid,

@@ -47,6 +47,12 @@ class History:
         return h
 
 
+def client_run_name(base: str, cid: int) -> str:
+    """Per-client run suffix (reference: wandb/tensorboard names get
+    ``_client_{cid}``, ``photon/clients/llm_config_functions.py:767-862``)."""
+    return f"{base}_client_{cid}"
+
+
 def make_wandb_run(project: str | None, run_name: str, config: dict | None = None):
     """Best-effort wandb init (reference: ``wandb_init``, gated here because
     the image has no wandb / no egress)."""

@@ -77,6 +77,23 @@ class Trainer:
         # micro × dp_degree global rows, where dp_degree covers the batch-
         # sharded mesh axes (data and fsdp)
         dp_degree = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        # batch/device-count adaptation (reference:
+        # ``photon/clients/llm_config_functions.py:865-900`` rounds the batch
+        # to the visible device count, with a warning): a global batch not
+        # divisible by the batch-sharded mesh degree is rounded DOWN to the
+        # nearest multiple so the jitted step's batch sharding is exact
+        gbs = cfg.train.global_batch_size
+        if gbs % dp_degree:
+            adapted = max((gbs // dp_degree) * dp_degree, dp_degree)
+            import warnings
+
+            warnings.warn(
+                f"global_batch_size {gbs} not divisible by data-parallel degree "
+                f"{dp_degree}; adapted to {adapted}",
+                stacklevel=2,
+            )
+            cfg.train.global_batch_size = adapted
+        self.effective_global_batch_size = cfg.train.global_batch_size
         micro = cfg.train.device_microbatch_size
         probed_step = None
         if micro == "auto":

@@ -76,3 +76,45 @@ def test_evaluate_loss_sane():
     out = t.evaluate(batches)
     assert 0 < out["eval/loss"] < 20
     assert out["eval/tokens"] == 3 * 4 * 31
+
+
+def test_batch_adapts_to_mesh_degree():
+    """global_batch_size not divisible by the dp degree is rounded down with
+    a warning (reference: batch/device-count adaptation,
+    ``llm_config_functions.py:865-900``)."""
+    import warnings
+
+    import jax
+
+    from photon_tpu.config.schema import (
+        Config, MeshConfig, ModelConfig, OptimizerConfig, SchedulerConfig, TrainConfig,
+    )
+    from photon_tpu.parallel.mesh import make_mesh
+    from photon_tpu.train.trainer import Trainer
+
+    cfg = Config(
+        model=ModelConfig(
+            d_model=32, n_layers=2, n_heads=2, max_seq_len=16, vocab_size=64,
+            attn_impl="xla", compute_dtype="float32",
+        ),
+        mesh=MeshConfig(data=2),
+        optimizer=OptimizerConfig(name="adamw", lr=1e-3),
+        scheduler=SchedulerConfig(t_warmup=2, t_max=50),
+        train=TrainConfig(global_batch_size=7, device_microbatch_size=1),
+    )
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        trainer = Trainer(cfg, mesh=make_mesh(cfg.mesh), init_seed=0)
+    assert trainer.effective_global_batch_size == 6
+    assert any("adapted" in str(w.message) for w in caught)
+    import numpy as np
+
+    batch = np.zeros((6, 16), np.int32)
+    trainer.state, m = trainer._train_step(trainer.state, batch)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_client_run_name():
+    from photon_tpu.metrics.history import client_run_name
+
+    assert client_run_name("run-a", 3) == "run-a_client_3"
