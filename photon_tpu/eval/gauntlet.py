@@ -186,19 +186,26 @@ class GauntletConfig:
         named averages, and an overall mean of categories."""
         out: dict[str, float] = {}
         cat_means: dict[str, float] = {}
+        missing = 0
         for cat, benches in self.categories.items():
             vals, weights = [], []
             for b in benches:
                 if b.name not in raw_scores:
+                    # a configured benchmark with no raw score (typo'd name,
+                    # task missing from the suite) must not silently shrink
+                    # the category average — surface it as a metric
+                    missing += 1
                     continue
                 out[f"gauntlet/{cat}/{b.name}"] = adj = self.adjust(
                     raw_scores[b.name], b.random_baseline
                 )
                 vals.append(adj)
                 weights.append(1.0 if self.weighting == "EQUAL" else b.scale)
-            if vals:
+            if vals and sum(weights) > 0:
                 cat_means[cat] = float(np.average(vals, weights=weights))
                 out[f"gauntlet/category/{cat}"] = cat_means[cat]
+        if missing:
+            out["gauntlet/missing_benchmarks"] = float(missing)
         for avg_name, cat_list in self.averages.items():
             present = [cat_means[c] for c in cat_list if c in cat_means]
             if present:

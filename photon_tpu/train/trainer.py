@@ -93,7 +93,6 @@ class Trainer:
                 stacklevel=2,
             )
             cfg.train.global_batch_size = adapted
-        self.effective_global_batch_size = cfg.train.global_batch_size
         micro = cfg.train.device_microbatch_size
         probed_step = None
         if micro == "auto":
@@ -101,9 +100,42 @@ class Trainer:
             # ``device_train_microbatch_size: auto``,
             # ``photon/clients/trainer_utils.py:972-978``)
             micro, probed_step = self._probe_microbatch(host_state, dp_degree)
+        else:
+            # a microbatch larger than the per-device batch would silently
+            # run one oversized scan chunk — clamp it to the batch
+            clamped = min(micro, cfg.train.global_batch_size // dp_degree)
+            if clamped != micro:
+                import warnings
+
+                warnings.warn(
+                    f"device_microbatch_size {micro} exceeds the per-device "
+                    f"batch {cfg.train.global_batch_size // dp_degree}; "
+                    f"clamped to {clamped}",
+                    stacklevel=2,
+                )
+            micro = clamped
         self.device_microbatch_size = micro
         rows_per_scan = micro * dp_degree
-        n_micro = max(1, cfg.train.global_batch_size // rows_per_scan)
+        # dp_degree-multiple adaptation alone is not enough: the scan needs
+        # the batch to split into EQUAL micro*dp_degree chunks, so round down
+        # again to a multiple of rows_per_scan (>= one chunk)
+        if cfg.train.global_batch_size % rows_per_scan:
+            adapted = max(
+                (cfg.train.global_batch_size // rows_per_scan) * rows_per_scan,
+                rows_per_scan,
+            )
+            import warnings
+
+            warnings.warn(
+                f"global_batch_size {cfg.train.global_batch_size} not divisible "
+                f"by microbatch rows-per-scan {rows_per_scan} "
+                f"(micro {micro} x dp {dp_degree}); adapted to {adapted}",
+                stacklevel=2,
+            )
+            cfg.train.global_batch_size = adapted
+        self.effective_global_batch_size = cfg.train.global_batch_size
+        n_micro = cfg.train.global_batch_size // rows_per_scan
+        assert n_micro * rows_per_scan == cfg.train.global_batch_size
         self._n_micro = n_micro
 
         self.state: TrainState = jax.tree.map(
