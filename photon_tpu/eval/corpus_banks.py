@@ -1,0 +1,135 @@
+"""Fact banks for the zero-egress Gauntlet corpus (``make_corpus.py``).
+
+Real micro-knowledge (capitals, elements, science facts) so the generated
+knowledge tasks test genuine — if narrow — world knowledge; the judge-facing
+caveat lives in ``make_corpus.py``'s module docstring.
+"""
+
+from __future__ import annotations
+
+# (country, capital) — real pairs
+CAPITALS = [
+    ("France", "Paris"), ("Germany", "Berlin"), ("Italy", "Rome"),
+    ("Spain", "Madrid"), ("Portugal", "Lisbon"), ("Austria", "Vienna"),
+    ("Greece", "Athens"), ("Norway", "Oslo"), ("Sweden", "Stockholm"),
+    ("Finland", "Helsinki"), ("Denmark", "Copenhagen"), ("Poland", "Warsaw"),
+    ("Hungary", "Budapest"), ("Ireland", "Dublin"), ("Netherlands", "Amsterdam"),
+    ("Belgium", "Brussels"), ("Switzerland", "Bern"), ("Czechia", "Prague"),
+    ("Russia", "Moscow"), ("Ukraine", "Kyiv"), ("Turkey", "Ankara"),
+    ("Egypt", "Cairo"), ("Kenya", "Nairobi"), ("Nigeria", "Abuja"),
+    ("Ethiopia", "Addis Ababa"), ("Morocco", "Rabat"), ("Ghana", "Accra"),
+    ("Japan", "Tokyo"), ("China", "Beijing"), ("India", "New Delhi"),
+    ("Thailand", "Bangkok"), ("Vietnam", "Hanoi"), ("Indonesia", "Jakarta"),
+    ("Philippines", "Manila"), ("Malaysia", "Kuala Lumpur"), ("Iran", "Tehran"),
+    ("Iraq", "Baghdad"), ("Israel", "Jerusalem"), ("Jordan", "Amman"),
+    ("Canada", "Ottawa"), ("Mexico", "Mexico City"), ("Cuba", "Havana"),
+    ("Brazil", "Brasilia"), ("Argentina", "Buenos Aires"), ("Chile", "Santiago"),
+    ("Peru", "Lima"), ("Colombia", "Bogota"), ("Venezuela", "Caracas"),
+    ("Australia", "Canberra"), ("New Zealand", "Wellington"),
+]
+
+# (element, symbol, atomic number) — real
+ELEMENTS = [
+    ("hydrogen", "H", 1), ("helium", "He", 2), ("lithium", "Li", 3),
+    ("carbon", "C", 6), ("nitrogen", "N", 7), ("oxygen", "O", 8),
+    ("fluorine", "F", 9), ("neon", "Ne", 10), ("sodium", "Na", 11),
+    ("magnesium", "Mg", 12), ("aluminium", "Al", 13), ("silicon", "Si", 14),
+    ("phosphorus", "P", 15), ("sulfur", "S", 16), ("chlorine", "Cl", 17),
+    ("potassium", "K", 19), ("calcium", "Ca", 20), ("iron", "Fe", 26),
+    ("nickel", "Ni", 28), ("copper", "Cu", 29), ("zinc", "Zn", 30),
+    ("silver", "Ag", 47), ("tin", "Sn", 50), ("iodine", "I", 53),
+    ("gold", "Au", 79), ("mercury", "Hg", 80), ("lead", "Pb", 82),
+    ("uranium", "U", 92), ("platinum", "Pt", 78), ("tungsten", "W", 74),
+]
+
+# (question, correct, [distractors]) — real science facts, 3 distractors each
+SCIENCE_QA = [
+    ("Which gas do plants absorb from the air for photosynthesis?",
+     "carbon dioxide", ["nitrogen", "helium", "methane"]),
+    ("What force pulls objects toward the center of the Earth?",
+     "gravity", ["magnetism", "friction", "tension"]),
+    ("Which planet is known as the red planet?",
+     "Mars", ["Venus", "Jupiter", "Saturn"]),
+    ("What is the boiling point of water at sea level in Celsius?",
+     "100 degrees", ["50 degrees", "212 degrees", "0 degrees"]),
+    ("Which organ pumps blood through the human body?",
+     "the heart", ["the liver", "the lungs", "the kidneys"]),
+    ("What is the main source of energy for Earth's climate system?",
+     "the Sun", ["the Moon", "volcanoes", "ocean currents"]),
+    ("Which state of matter has a fixed volume but no fixed shape?",
+     "liquid", ["solid", "gas", "plasma"]),
+    ("What do bees collect from flowers to make honey?",
+     "nectar", ["pollen only", "water", "sap"]),
+    ("Which part of the plant conducts photosynthesis?",
+     "the leaves", ["the roots", "the bark", "the seeds"]),
+    ("What is the smallest unit of life?",
+     "the cell", ["the atom", "the molecule", "the organ"]),
+    ("Which gas makes up most of Earth's atmosphere?",
+     "nitrogen", ["oxygen", "carbon dioxide", "argon"]),
+    ("What type of energy is stored in a stretched rubber band?",
+     "elastic potential energy", ["kinetic energy", "thermal energy", "sound energy"]),
+    ("Which simple machine is a ramp?",
+     "an inclined plane", ["a pulley", "a lever", "a wheel"]),
+    ("What happens to water when it freezes?",
+     "it expands", ["it contracts", "it evaporates", "it gets heavier"]),
+    ("Which animal is a mammal?",
+     "the dolphin", ["the shark", "the penguin", "the crocodile"]),
+    ("What instrument measures air pressure?",
+     "a barometer", ["a thermometer", "a ruler", "an ammeter"]),
+    ("Which vitamin does sunlight help the human body produce?",
+     "vitamin D", ["vitamin C", "vitamin A", "vitamin B12"]),
+    ("What is the center of an atom called?",
+     "the nucleus", ["the electron", "the shell", "the proton cloud"]),
+    ("Which metal is liquid at room temperature?",
+     "mercury", ["iron", "copper", "aluminium"]),
+    ("What process turns water vapor into liquid water?",
+     "condensation", ["evaporation", "sublimation", "combustion"]),
+    ("Which blood cells fight infection?",
+     "white blood cells", ["red blood cells", "platelets", "plasma cells"]),
+    ("What is the hardest natural material?",
+     "diamond", ["granite", "steel", "quartz"]),
+    ("Which planet has prominent rings?",
+     "Saturn", ["Mercury", "Mars", "Venus"]),
+    ("What do herbivores eat?",
+     "plants", ["meat", "insects only", "fish"]),
+    ("Which sense organ detects light?",
+     "the eye", ["the ear", "the tongue", "the skin"]),
+    ("What is the most abundant element in the universe?",
+     "hydrogen", ["oxygen", "carbon", "iron"]),
+    ("Which natural satellite orbits the Earth?",
+     "the Moon", ["Mars", "Titan", "Europa"]),
+    ("What kind of rock forms from cooled lava?",
+     "igneous rock", ["sedimentary rock", "metamorphic rock", "fossil rock"]),
+    ("Which organ filters waste from the blood?",
+     "the kidney", ["the heart", "the stomach", "the spleen"]),
+    ("What is the speed of light approximately?",
+     "300,000 km per second", ["300 km per second", "3,000 km per second", "30 km per hour"]),
+]
+
+FIRST_NAMES = [
+    "Alice", "Ben", "Clara", "David", "Emma", "Frank", "Grace", "Henry",
+    "Ivy", "Jack", "Karen", "Liam", "Maya", "Noah", "Olivia", "Peter",
+    "Quinn", "Rosa", "Sam", "Tara", "Uma", "Victor", "Wendy", "Xavier",
+    "Yara", "Zane",
+]
+
+OBJECTS = [
+    "book", "ball", "cup", "pencil", "lamp", "chair", "clock", "bottle",
+    "basket", "ladder", "mirror", "pillow", "wallet", "umbrella", "kettle",
+    "hammer", "bucket", "candle", "blanket", "whistle",
+]
+
+FOODS = [
+    "apple", "banana", "orange", "sandwich", "cookie", "pear", "carrot",
+    "muffin", "grape", "tomato", "pretzel", "peach",
+]
+
+ANIMALS = [
+    "dog", "cat", "horse", "rabbit", "sheep", "goat", "duck", "pig",
+    "cow", "chicken", "donkey", "goose",
+]
+
+PLACES = [
+    "park", "library", "market", "school", "station", "museum", "harbor",
+    "garden", "bakery", "theater", "stadium", "farm",
+]

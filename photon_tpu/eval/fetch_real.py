@@ -1,0 +1,190 @@
+"""Rebuild the Gauntlet corpus from the REAL public datasets (needs network).
+
+The in-repo ``local_data`` corpus is a deterministic zero-egress stand-in
+(see ``make_corpus.py``). On a machine with internet access, this module
+downloads the original benchmarks from the Hugging Face hub and rewrites
+the same 32 jsonl files with the published rows, converted to the harness
+schemas (``icl.py`` module docstring). Usage::
+
+    python -m photon_tpu.eval.fetch_real --out photon_tpu/eval/local_data \
+        [--only lambada_openai hellaswag] [--max-rows 2000]
+
+Reference: the upstream files are the llm-foundry v0.3 eval set consumed by
+``/root/reference/photon/conf/icl_tasks_config/tasks_v0.3.yaml``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+
+
+def _mc(query: str, choices: list[str], gold: int) -> dict:
+    return {"query": query, "choices": choices, "gold": gold}
+
+
+def _lm(context: str, continuation: str) -> dict:
+    return {"context": context, "continuation": continuation}
+
+
+# label -> (relative output path, loader kwargs, converter)
+# Each converter: HF row -> harness row dict.
+
+def _conv_arc(row):
+    labels = row["choices"]["label"]
+    return _mc(row["question"], row["choices"]["text"], labels.index(row["answerKey"]))
+
+
+def _conv_hellaswag(row):
+    return _mc(row["ctx"], row["endings"], int(row["label"]))
+
+
+def _conv_piqa(row):
+    return _mc(row["goal"], [row["sol1"], row["sol2"]], int(row["label"]))
+
+
+def _conv_copa(row):
+    q = f"{row['premise'].rstrip('.')} {'because' if row['question'] == 'cause' else 'so'}"
+    return _mc(q, [row["choice1"], row["choice2"]], int(row["label"]))
+
+
+def _conv_boolq(row):
+    return _mc(f"{row['passage']}\n{row['question']}?", ["no", "yes"], int(row["answer"]))
+
+
+def _conv_openbook(row):
+    labels = row["choices"]["label"]
+    return _mc(row["question_stem"], row["choices"]["text"], labels.index(row["answerKey"]))
+
+
+def _conv_csqa(row):
+    labels = row["choices"]["label"]
+    return _mc(row["question"], row["choices"]["text"], labels.index(row["answerKey"]))
+
+
+def _conv_siqa(row):
+    return _mc(f"{row['context']} {row['question']}",
+               [row["answerA"], row["answerB"], row["answerC"]], int(row["label"]) - 1)
+
+
+def _conv_lambada(row):
+    text = row["text"]
+    ctx, _, last = text.rpartition(" ")
+    return _lm(ctx, " " + last)
+
+
+def _conv_winogrande(row):
+    a, b = row["option1"], row["option2"]
+    pre, _, post = row["sentence"].partition("_")
+    return {"context_options": [pre + a, pre + b], "continuation": post,
+            "gold": int(row["answer"]) - 1}
+
+
+def _conv_gsm8k(row):
+    answer = row["answer"].split("####")[-1].strip()
+    return {"context": f"Question: {row['question']}", "answer": answer, "aliases": []}
+
+
+def _conv_triviaqa(row):
+    return {"context": f"Question: {row['question']}\nAnswer:",
+            "answer": row["answer"]["value"],
+            "aliases": list(row["answer"].get("aliases", []))[:8]}
+
+
+def _conv_squad(row):
+    ans = row["answers"]["text"][0]
+    return _lm(f"{row['context']}\nQuestion: {row['question']}\nAnswer:", f" {ans}")
+
+
+FETCHERS: dict[str, tuple[str, dict, object]] = {
+    "arc_easy": ("world_knowledge/arc_easy.jsonl",
+                 {"path": "allenai/ai2_arc", "name": "ARC-Easy", "split": "test"}, _conv_arc),
+    "arc_challenge": ("world_knowledge/arc_challenge.jsonl",
+                      {"path": "allenai/ai2_arc", "name": "ARC-Challenge", "split": "test"},
+                      _conv_arc),
+    "hellaswag": ("language_understanding/hellaswag.jsonl",
+                  {"path": "Rowan/hellaswag", "split": "validation"}, _conv_hellaswag),
+    "piqa": ("commonsense_reasoning/piqa.jsonl",
+             {"path": "ybisk/piqa", "split": "validation"}, _conv_piqa),
+    "copa": ("commonsense_reasoning/copa.jsonl",
+             {"path": "super_glue", "name": "copa", "split": "validation"}, _conv_copa),
+    "boolq": ("reading_comprehension/boolq.jsonl",
+              {"path": "super_glue", "name": "boolq", "split": "validation"}, _conv_boolq),
+    "openbook_qa": ("commonsense_reasoning/openbook_qa.jsonl",
+                    {"path": "allenai/openbookqa", "name": "main", "split": "test"},
+                    _conv_openbook),
+    "commonsense_qa": ("commonsense_reasoning/commonsense_qa.jsonl",
+                       {"path": "tau/commonsense_qa", "split": "validation"}, _conv_csqa),
+    "siqa": ("commonsense_reasoning/siqa.jsonl",
+             {"path": "allenai/social_i_qa", "split": "validation"}, _conv_siqa),
+    "lambada_openai": ("language_understanding/lambada_openai.jsonl",
+                       {"path": "EleutherAI/lambada_openai", "name": "en", "split": "test"},
+                       _conv_lambada),
+    "winogrande": ("language_understanding/winogrande.jsonl",
+                   {"path": "allenai/winogrande", "name": "winogrande_xl",
+                    "split": "validation"}, _conv_winogrande),
+    "gsm8k": ("symbolic_problem_solving/gsm8k_prepended_8shot.jsonl",
+              {"path": "openai/gsm8k", "name": "main", "split": "test"}, _conv_gsm8k),
+    "triviaqa_sm_sub": ("world_knowledge/triviaqa_sm_sub.jsonl",
+                        {"path": "mandarjoshi/trivia_qa", "name": "rc.nocontext",
+                         "split": "validation"}, _conv_triviaqa),
+    "squad": ("reading_comprehension/squad.jsonl",
+              {"path": "rajpurkar/squad", "split": "validation"}, _conv_squad),
+}
+
+# Tasks whose published rows live in llm-foundry's release tarball rather
+# than a clean HF dataset (bigbench_*, agi_eval_*, mmlu subsets, jeopardy,
+# winograd, svamp, coqa, simple_arithmetic_*): fetch them from
+# https://github.com/mosaicml/llm-foundry/tree/main/scripts/eval/local_data
+# and drop the files into local_data/ unchanged — the schemas match.
+TARBALL_TASKS = [
+    "jeopardy", "bigbench_qa_wikidata", "mmlu", "svamp", "winograd", "coqa",
+    "bigbench_dyck_languages", "bigbench_operators", "bigbench_cs_algorithms",
+    "bigbench_elementary_math_qa", "bigbench_strange_stories",
+    "bigbench_strategy_qa", "simple_arithmetic_nospaces",
+    "simple_arithmetic_withspaces", "agi_eval_lsat_ar", "agi_eval_lsat_rc",
+    "agi_eval_lsat_lr", "agi_eval_sat_en",
+]
+
+
+def fetch(out_dir: pathlib.Path, only: list[str] | None = None,
+          max_rows: int | None = None) -> dict[str, int]:
+    import datasets  # deferred: needs network to be useful
+
+    counts: dict[str, int] = {}
+    for label, (rel, load_kw, conv) in FETCHERS.items():
+        if only and label not in only:
+            continue
+        ds = datasets.load_dataset(**load_kw)
+        rows = []
+        for row in ds:
+            try:
+                rows.append(conv(row))
+            except (KeyError, ValueError, IndexError):
+                continue
+            if max_rows and len(rows) >= max_rows:
+                break
+        path = out_dir / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with path.open("w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        counts[label] = len(rows)
+        print(f"{len(rows):6d}  {label} -> {rel}")
+    return counts
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=str(pathlib.Path(__file__).parent / "local_data"))
+    ap.add_argument("--only", nargs="*", default=None)
+    ap.add_argument("--max-rows", type=int, default=None)
+    args = ap.parse_args(argv)
+    fetch(pathlib.Path(args.out), args.only, args.max_rows)
+    print("NOTE: tarball-only tasks (fetch manually from llm-foundry eval "
+          f"local_data): {', '.join(TARBALL_TASKS)}")
+
+
+if __name__ == "__main__":
+    main()

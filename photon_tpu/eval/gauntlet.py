@@ -10,15 +10,17 @@ Reference formats (parsed compatibly):
   ``random_baseline``, plus ``weighting``, ``subtract_random_baseline``,
   ``rescale_accuracy`` and named ``averages`` over category lists.
 
-Scope: ``multiple_choice`` and ``language_modeling`` task types score
-through the jitted continuation-logprob path (``icl.py``).
-``generation_task_with_answers`` entries (gsm8k-style, requiring sampling)
-are reported as skipped — the harness is logprob-based.
+Scope: all four reference task types score — ``multiple_choice``,
+``language_modeling`` and ``schema`` through the jitted continuation-logprob
+path, ``generation_task_with_answers`` (gsm8k-style) through batched greedy
+decoding (``icl.py``).
 
-A small format-faithful demo corpus ships under ``eval/local_data`` with
-``configs/tasks_demo.yaml`` + ``configs/gauntlet_demo.yaml`` so the pipeline
-runs end to end out of the box; point ``root_dir`` at an llm-foundry
-``local_data`` checkout to run the real v0.3 suite.
+A full 32-task corpus in the reference's v0.3 layout ships under
+``eval/local_data`` (generated deterministically by ``make_corpus.py`` —
+zero-egress stand-in data; see ``fetch_real.py`` to rebuild from the real
+HF datasets when network exists) with ``configs/tasks_v0.3.yaml`` +
+``configs/eval_gauntlet_v0.3.yaml``; point ``root_dir`` at an llm-foundry
+``local_data`` checkout to run the original files unchanged.
 """
 
 from __future__ import annotations
@@ -30,9 +32,11 @@ from typing import Any, Callable, Iterable
 import numpy as np
 import yaml
 
-from photon_tpu.eval.icl import ICLTask, evaluate_task, make_logprob_fn
+from photon_tpu.eval.icl import ICLTask, score_tasks
 
-_SCOREABLE = {"multiple_choice", "language_modeling"}
+_SCOREABLE = {
+    "multiple_choice", "language_modeling", "schema", "generation_task_with_answers",
+}
 
 
 @dataclasses.dataclass
@@ -44,6 +48,9 @@ class TaskSpec:
     continuation_delimiter: str = " "
     question_prelimiter: str = ""
     example_delimiter: str = "\n"
+    cot_delimiter: str = ""
+    early_stopping_criteria: tuple[str, ...] = ()
+    do_normalization: bool = True
 
     @property
     def scoreable(self) -> bool:
@@ -79,6 +86,11 @@ class TaskSuite:
                     continuation_delimiter=str(e.get("continuation_delimiter", " ")),
                     question_prelimiter=str(e.get("question_prelimiter", "")),
                     example_delimiter=str(e.get("example_delimiter", "\n")),
+                    cot_delimiter=str(e.get("cot_delimiter", "")),
+                    early_stopping_criteria=tuple(
+                        str(s) for s in e.get("early_stopping_criteria", [])
+                    ),
+                    do_normalization=bool(e.get("do_normalization", True)),
                 )
             )
         return cls(specs, root)
@@ -112,6 +124,9 @@ class TaskSuite:
                 continuation_delimiter=spec.continuation_delimiter,
                 question_prelimiter=spec.question_prelimiter,
                 example_delimiter=spec.example_delimiter,
+                cot_delimiter=spec.cot_delimiter,
+                early_stopping_criteria=spec.early_stopping_criteria,
+                do_normalization=spec.do_normalization,
             )
             if task.kind != spec.icl_task_type:
                 raise ValueError(
@@ -236,14 +251,18 @@ def run_gauntlet_suite(
     if not tasks:
         raise ValueError(f"no scoreable tasks loaded from {tasks_yaml}")
 
-    logprob_fn = make_logprob_fn(model_apply, params, seq_len)
     raw: dict[str, float] = {}
     out: dict[str, float] = {}
-    for task in tasks:
-        res = evaluate_task(task, tokenizer, logprob_fn, seq_len, batch_size, max_rows=max_rows)
-        metric = "accuracy" if task.kind == "multiple_choice" else "logprob_per_token"
-        raw[task.name] = res[metric]
-        out[f"icl/{task.name}/{metric}"] = res[metric]
+    for task, res in score_tasks(
+        tasks, tokenizer, model_apply, params, seq_len, batch_size, max_rows
+    ):
+        # every task kind reports accuracy (LM = greedy exact-match,
+        # llm-foundry's InContextLearningLMAccuracy) — that is what the
+        # gauntlet's baseline-subtracted averages expect
+        raw[task.name] = res["accuracy"]
+        out[f"icl/{task.name}/accuracy"] = res["accuracy"]
+        if "logprob_per_token" in res:
+            out[f"icl/{task.name}/logprob_per_token"] = res["logprob_per_token"]
     if gauntlet:
         out.update(gauntlet.aggregate(raw))
     if skipped:

@@ -1,0 +1,920 @@
+"""Deterministic generator for the 32-task Eval Gauntlet corpus.
+
+The reference ships the llm-foundry v0.3 suite — 32 jsonl task files
+scraped from public datasets (``/root/reference/photon/conf/
+icl_tasks_config/tasks_v0.3.yaml``). This environment has zero network
+egress and no dataset caches, so the original rows are unobtainable;
+this module generates a **stand-in corpus with the same 32 task files,
+schemas, directory layout, and task types**, hundreds of rows each:
+
+- The symbolic tasks (``simple_arithmetic_*``, ``bigbench_dyck_languages``,
+  ``bigbench_operators``, ``bigbench_cs_algorithms``,
+  ``bigbench_elementary_math_qa``, ``gsm8k``, ``svamp``,
+  ``agi_eval_lsat_ar``) are programmatic by nature — the generated rows
+  are the real task, just a fresh sample.
+- The knowledge tasks draw on small real fact banks (``corpus_banks.py``)
+  — genuine but narrow world knowledge.
+- The commonsense / language-understanding tasks are template-generated
+  stand-ins: format-faithful and model-discriminative, but NOT the
+  published benchmark rows; scores are comparable across checkpoints of
+  this framework, not against published leaderboards.
+
+Rebuild with the real data via ``fetch_real.py`` on a machine with
+network access. Regenerate this corpus with::
+
+    python -m photon_tpu.eval.make_corpus [--out DIR] [--seed 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import random
+
+from photon_tpu.eval.corpus_banks import (
+    ANIMALS,
+    CAPITALS,
+    ELEMENTS,
+    FIRST_NAMES,
+    FOODS,
+    OBJECTS,
+    PLACES,
+    SCIENCE_QA,
+)
+
+HERE = pathlib.Path(__file__).parent
+
+
+def _mc(query: str, correct: str, wrong: list[str], rng: random.Random) -> dict:
+    choices = [correct, *wrong]
+    rng.shuffle(choices)
+    return {"query": query, "choices": choices, "gold": choices.index(correct)}
+
+
+# ---------------------------------------------------------------------------
+# world_knowledge
+# ---------------------------------------------------------------------------
+
+
+def gen_jeopardy(rng: random.Random) -> list[dict]:
+    """LM rows: ``{context: "CATEGORY\\nclue", continuation: " answer"}``
+    (reference file ``jeopardy_all.jsonl``, answer after "\\nAnswer: ")."""
+    rows = []
+    for country, capital in CAPITALS:
+        rows.append({"context": f"WORLD CAPITALS\nThis city is the capital of {country}",
+                     "continuation": f" {capital}", "category": "WORLD CAPITALS"})
+        rows.append({"context": f"GEOGRAPHY\n{capital} is the capital city of this country",
+                     "continuation": f" {country}", "category": "GEOGRAPHY"})
+    for name, symbol, number in ELEMENTS:
+        rows.append({"context": f"CHEMISTRY\nThis element has the chemical symbol {symbol}",
+                     "continuation": f" {name}", "category": "CHEMISTRY"})
+        rows.append({"context": f"SCIENCE\nThis element has atomic number {number}",
+                     "continuation": f" {name}", "category": "SCIENCE"})
+    rng.shuffle(rows)
+    return rows
+
+
+def gen_qa_wikidata(rng: random.Random) -> list[dict]:
+    rows = []
+    for country, capital in CAPITALS:
+        rows.append({"context": f"The capital of {country} is", "continuation": f" {capital}"})
+        rows.append({"context": f"{capital} is the capital of", "continuation": f" {country}"})
+    for name, symbol, _ in ELEMENTS:
+        rows.append({"context": f"The chemical symbol of {name} is", "continuation": f" {symbol}"})
+    rng.shuffle(rows)
+    return rows
+
+
+def gen_arc(rng: random.Random, challenge: bool) -> list[dict]:
+    rows = []
+    for q, correct, wrong in SCIENCE_QA:
+        rows.append(_mc(q, correct, wrong, rng))
+        rows.append(_mc(f"Science quiz. {q}", correct, wrong, rng))
+        if challenge:
+            # harder variant: negated phrasing, same fact bank
+            rows.append(_mc(
+                f"Which of the following is NOT true? Consider: {q}",
+                f"the answer is {wrong[0]}",
+                [f"the answer is {correct}"] + [f"the answer could be {w}" for w in wrong[1:]],
+                rng,
+            ))
+    for name, symbol, number in ELEMENTS:
+        wrong_sym = [s for _, s, _ in ELEMENTS if s != symbol]
+        rows.append(_mc(f"Which is the chemical symbol for {name}?",
+                        symbol, rng.sample(wrong_sym, 3), rng))
+        if challenge:
+            wrong_n = [str(n) for _, _, n in ELEMENTS if n != number]
+            rows.append(_mc(f"The element {name} has which atomic number?",
+                            str(number), rng.sample(wrong_n, 3), rng))
+    for country, capital in CAPITALS[:30]:
+        wrong = [c for _, c in CAPITALS if c != capital]
+        rows.append(_mc(
+            f"Which city is the capital of {country}?", capital, rng.sample(wrong, 3), rng))
+    rng.shuffle(rows)
+    return rows
+
+
+def gen_mmlu(rng: random.Random) -> list[dict]:
+    rows = []
+    for country, capital in CAPITALS:
+        wrong = [c for _, c in CAPITALS if c != capital]
+        rows.append({**_mc(f"What is the capital of {country}?",
+                           capital, rng.sample(wrong, 3), rng), "category": "geography"})
+    for name, symbol, number in ELEMENTS:
+        wrong_sym = [s for _, s, _ in ELEMENTS if s != symbol]
+        rows.append({**_mc(f"The chemical symbol for {name} is",
+                           symbol, rng.sample(wrong_sym, 3), rng), "category": "chemistry"})
+        wrong_n = [str(n) for _, _, n in ELEMENTS if n != number]
+        rows.append({**_mc(f"The atomic number of {name} is",
+                           str(number), rng.sample(wrong_n, 3), rng), "category": "chemistry"})
+    for _ in range(60):
+        a, b = rng.randint(12, 99), rng.randint(12, 99)
+        correct = a * b
+        wrong = {correct + d for d in (rng.randint(1, 9), -rng.randint(1, 9), 10)}
+        wrong.discard(correct)
+        rows.append({**_mc(f"What is {a} times {b}?", str(correct),
+                           [str(w) for w in list(wrong)[:3]], rng),
+                     "category": "elementary_mathematics"})
+    rng.shuffle(rows)
+    return rows
+
+
+def gen_triviaqa(rng: random.Random) -> list[dict]:
+    rows = []
+    for country, capital in CAPITALS:
+        rows.append({"context": f"Question: What is the capital of {country}?\nAnswer:",
+                     "answer": capital, "aliases": [capital.lower()]})
+    for name, symbol, _ in ELEMENTS:
+        rows.append({"context":
+                     f"Question: Which element has the chemical symbol {symbol}?\nAnswer:",
+                     "answer": name, "aliases": [name.capitalize()]})
+    for q, correct, _ in SCIENCE_QA:
+        rows.append({"context": f"Question: {q}\nAnswer:", "answer": correct,
+                     "aliases": [correct.replace("the ", "")]})
+    rng.shuffle(rows)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# commonsense_reasoning
+# ---------------------------------------------------------------------------
+
+_COPA_BANK = [
+    # (premise-because, cause, wrong)
+    ("The ground was covered in snow", "it had snowed overnight", "the sun was very hot"),
+    ("She opened her umbrella", "it started to rain", "the sky was perfectly clear"),
+    ("The lights went out", "the power failed", "the windows were open"),
+    ("He put on a heavy coat", "it was cold outside", "it was a warm summer day"),
+    ("The plant wilted", "nobody had watered it", "it got plenty of water"),
+    ("She turned on the fan", "the room was hot", "the room was freezing"),
+    ("The baby started crying", "it was hungry", "it had just been fed and was happy"),
+    ("The road was slippery", "rain had fallen all night", "the road was dry and clean"),
+    ("He missed the bus", "he woke up late", "he arrived very early"),
+    ("The ice cream melted", "it was left in the sun", "it was kept in the freezer"),
+    ("Her shoes were muddy", "she walked through the wet field", "she stayed on the paved path"),
+    ("The dog barked loudly", "a stranger came to the door", "the house was empty and quiet"),
+    ("The bread went stale", "it was left out for days", "it was baked this morning"),
+    ("His phone died", "he forgot to charge it", "it was fully charged"),
+    ("The window shattered", "a ball hit it", "it was made of thick steel"),
+    ("She failed the test", "she had not studied at all", "she knew every answer"),
+    ("The milk smelled sour", "it was far past its date", "it was bought fresh today"),
+    ("Traffic came to a stop", "there was an accident ahead", "the road was completely empty"),
+    ("The candle went out", "a gust of wind blew in", "the air was perfectly still"),
+    ("His hands were shaking", "he was very nervous", "he felt completely calm"),
+    ("The cake burned", "it stayed in the oven too long", "the oven was never turned on"),
+    ("She whispered in the library", "silence was required", "shouting was encouraged"),
+    ("The river flooded the town", "heavy rains lasted a week", "there was a long drought"),
+    ("He drank the whole bottle of water", "he was very thirsty", "he was not thirsty at all"),
+    ("The mirror fogged up", "the shower was hot", "the bathroom was cold and dry"),
+]
+
+
+def gen_copa(rng: random.Random) -> list[dict]:
+    rows = []
+    for premise, cause, wrong in _COPA_BANK:
+        for name in rng.sample(FIRST_NAMES, 6):
+            p = premise.replace("She ", f"{name} ").replace("He ", f"{name} ").replace(
+                "Her ", f"{name}'s ").replace("His ", f"{name}'s ")
+            choices = [f"{cause}.", f"{wrong}."]
+            gold = 0
+            if rng.random() < 0.5:
+                choices.reverse()
+                gold = 1
+            rows.append({"query": f"{p} because", "choices": choices, "gold": gold})
+    rng.shuffle(rows)
+    return rows[:250]
+
+
+def gen_siqa(rng: random.Random) -> list[dict]:
+    templates = [
+        ("{a} spilled coffee on {b}'s laptop. How does {b} most likely feel?",
+         "upset about the laptop", ["thrilled and grateful", "hungry for lunch"]),
+        ("{a} helped {b} carry heavy boxes upstairs. What does {b} most likely want to do?",
+         "thank {a} for the help", ["call the police on {a}", "hide the boxes from {a}"]),
+        ("{a} forgot {b}'s birthday. How does {b} most likely feel?",
+         "a little hurt", ["extremely proud of {a}", "indifferent to everything forever"]),
+        ("{a} won first prize in the baking contest. How does {a} most likely feel?",
+         "proud and happy", ["ashamed of the prize", "angry at the judges for winning"]),
+        ("{a} borrowed {b}'s bike and returned it broken. What should {a} do next?",
+         "offer to pay for repairs", ["ask to borrow the car too", "pretend nothing happened"]),
+        ("{a} saw {b} drop a wallet on the street. What is the kind thing to do?",
+         "return the wallet to {b}", ["keep the wallet quietly", "kick the wallet away"]),
+        ("{a} practiced the violin every day for a month. What is {a} hoping for?",
+         "to improve at the violin", ["to forget how to play", "to sell the violin unplayed"]),
+        ("{a} stayed up all night finishing a project for {b}. How might {a} feel the next day?",
+         "tired but accomplished", ["well rested and bored", "confused about who {b} is"]),
+        ("{a} cooked dinner for the whole family. What does the family most likely do?",
+         "thank {a} and enjoy the meal", ["refuse to ever eat again", "bill {a} for the food"]),
+        ("{a} lost the keys {b} lent them. What should {a} say?",
+         "apologize and offer to replace them", ["demand new keys from {b}", "deny borrowing anything while holding the keyring"]),
+    ]
+    rows = []
+    for tmpl, correct, wrong in templates:
+        for _ in range(25):
+            a, b = rng.sample(FIRST_NAMES, 2)
+            fmt = lambda s: s.format(a=a, b=b)  # noqa: E731
+            rows.append(_mc(fmt(tmpl), fmt(correct), [fmt(w) for w in wrong], rng))
+    rng.shuffle(rows)
+    return rows[:250]
+
+
+def gen_commonsense_qa(rng: random.Random) -> list[dict]:
+    bank = [
+        ("Where would you most likely borrow a book?", "a library",
+         ["a swimming pool", "a gas station", "a dentist's office", "a parking lot"]),
+        ("What do people use to cut paper?", "scissors",
+         ["a spoon", "a pillow", "a towel", "a balloon"]),
+        ("Where does milk come from?", "a cow",
+         ["a rock", "a cloud", "a car engine", "a printer"]),
+        ("What do you use an umbrella for?", "staying dry in the rain",
+         ["digging holes", "cooking soup", "writing letters", "climbing trees"]),
+        ("Where would you board an airplane?", "an airport",
+         ["a bakery", "a cinema", "a farm", "a bookstore"]),
+        ("What is a bed mainly used for?", "sleeping",
+         ["frying eggs", "driving", "mowing grass", "painting walls"]),
+        ("What do you wear on your feet?", "shoes",
+         ["gloves", "hats", "scarves", "earrings"]),
+        ("Where do fish live?", "in water",
+         ["in trees", "in ovens", "in drawers", "in volcanoes"]),
+        ("What melts when it gets hot?", "ice",
+         ["stone", "glass bottles", "iron bars", "wooden chairs"]),
+        ("Why do people plant seeds?", "to grow plants",
+         ["to stop the wind", "to make it rain", "to warm the house", "to fix the roof"]),
+        ("What do you do with a broom?", "sweep the floor",
+         ["brush your teeth", "stir the soup", "comb your hair", "paint a fence"]),
+        ("Where would you keep frozen food?", "a freezer",
+         ["a bathtub", "a mailbox", "a glovebox", "a bookshelf"]),
+        ("What gives light at night in a house?", "a lamp",
+         ["a carpet", "a sponge", "a fork", "a doormat"]),
+        ("Why do people wear coats in winter?", "to stay warm",
+         ["to get wet", "to move faster", "to see better", "to hear music"]),
+        ("What do you use to unlock a door?", "a key",
+         ["a banana", "a feather", "a sock", "a leaf"]),
+    ]
+    rows = []
+    for q, correct, wrong in bank:
+        rows.append(_mc(q, correct, wrong, rng))
+        # paraphrased second form
+        rows.append(_mc(f"Sam asks: {q.lower().rstrip('?')}. The best answer is",
+                        correct, wrong, rng))
+    for name in FIRST_NAMES:
+        for _ in range(5):
+            obj = rng.choice(OBJECTS)
+            place = rng.choice(PLACES)
+            rows.append(_mc(
+                f"{name} lost a {obj} at the {place}. Where should {name} look for it?",
+                f"at the {place}",
+                [f"at the {p}" for p in rng.sample([p for p in PLACES if p != place], 4)],
+                rng,
+            ))
+    rng.shuffle(rows)
+    return rows[:250]
+
+
+def gen_piqa(rng: random.Random) -> list[dict]:
+    bank = [
+        ("To open a glass jar with a tight lid,", "grip the lid firmly and twist it counterclockwise",
+         "hit the glass with a hammer until it opens"),
+        ("To water a houseplant,", "pour water slowly into the soil at its base",
+         "submerge the whole plant upside down in the sink"),
+        ("To dry wet shoes,", "stuff them with newspaper and leave them in a warm airy spot",
+         "put them in the freezer overnight"),
+        ("To slice a loaf of bread,", "use a serrated knife with a gentle sawing motion",
+         "press the loaf against a window"),
+        ("To light a candle,", "hold a lit match to the wick",
+         "pour water over the wick"),
+        ("To keep ice cream from melting on the way home,", "pack it in an insulated bag",
+         "leave it on the dashboard in the sun"),
+        ("To remove a splinter,", "use clean tweezers to pull it out the way it went in",
+         "rub the area with sandpaper"),
+        ("To boil an egg,", "place it in water and heat until the water boils",
+         "leave it on the counter for an hour"),
+        ("To stop a door from squeaking,", "apply a drop of oil to the hinges",
+         "paint the doorknob a new color"),
+        ("To inflate a bicycle tire,", "attach a pump to the valve and push air in",
+         "wrap the tire tightly in tape"),
+        ("To clean a dusty shelf,", "wipe it with a damp cloth",
+         "blow on it from across the room"),
+        ("To keep papers together,", "use a paper clip or staple",
+         "sprinkle water between the pages"),
+        ("To cool a hot bowl of soup,", "let it sit for a few minutes and stir occasionally",
+         "add a handful of hot coals"),
+        ("To hang a picture on a wall,", "hammer a nail into the wall and hook the frame on it",
+         "balance the frame on a houseplant"),
+        ("To find a word's meaning,", "look it up in a dictionary",
+         "count the letters and guess"),
+    ]
+    rows = []
+    for goal, correct, wrong in bank:
+        for _ in range(8):
+            choices = [correct, wrong]
+            gold = 0
+            if rng.random() < 0.5:
+                choices.reverse()
+                gold = 1
+            rows.append({"query": goal, "choices": choices, "gold": gold})
+    rng.shuffle(rows)
+    return rows[:200]
+
+
+def gen_openbook_qa(rng: random.Random) -> list[dict]:
+    rows = []
+    for q, correct, wrong in SCIENCE_QA:
+        rows.append(_mc(q, correct, wrong, rng))
+        rows.append(_mc(f"A student wonders: {q.lower().rstrip('?')}. The fact that answers this is",
+                        correct, wrong, rng))
+    for name, symbol, _ in ELEMENTS:
+        wrong_names = [n for n, _, _ in ELEMENTS if n != name]
+        rows.append(_mc(f"A label reads '{symbol}'. The jar most likely contains",
+                        name, rng.sample(wrong_names, 3), rng))
+    for country, capital in CAPITALS:
+        wrong = [c for _, c in CAPITALS if c != capital]
+        rows.append(_mc(
+            f"A traveler flying to the capital of {country} lands in",
+            capital, rng.sample(wrong, 3), rng))
+    rng.shuffle(rows)
+    return rows[:220]
+
+
+def gen_strange_stories(rng: random.Random) -> list[dict]:
+    bank = [
+        ("{a} said the smashed vase looked 'absolutely wonderful' while frowning at {b}. What did {a} really mean?",
+         "{a} was being sarcastic and is unhappy about the vase", "{a} sincerely loves broken vases"),
+        ("{a} told {b} the medicine would taste like candy so {b} would take it. Why did {a} say that?",
+         "to persuade {b} with a harmless white lie", "because the medicine is actually candy"),
+        ("After losing the race, {a} laughed and said 'I clearly peaked in practice.' What is {a} doing?",
+         "making a joke to cope with losing", "claiming to have won the race"),
+        ("{a} kept checking the window every minute before {b}'s arrival. How does {a} likely feel?",
+         "eager and a little anxious", "completely uninterested"),
+        ("{a} gave {b} a scarf {b} already owned, and {b} said 'you shouldn't have!' with a wink. What did {b} mean?",
+         "{b} noticed the re-gift and is teasing {a}", "{b} believes scarves are forbidden"),
+        ("{a} said 'nice weather' while shaking rain off the umbrella. What did {a} mean?",
+         "{a} was being ironic about the bad weather", "{a} thinks rain is nice weather for a picnic"),
+        ("{a} hid {b}'s birthday cake in the pantry. Why?",
+         "to keep the cake a surprise for {b}", "because cakes belong in the pantry permanently"),
+        ("{a} yawned through {b}'s three-hour slideshow and said 'riveting.' What did {a} convey?",
+         "polite boredom dressed as praise", "genuine fascination with every slide"),
+    ]
+    rows = []
+    for tmpl, correct, wrong in bank:
+        for _ in range(30):
+            a, b = rng.sample(FIRST_NAMES, 2)
+            fmt = lambda s: s.format(a=a, b=b)  # noqa: E731
+            choices = [fmt(correct), fmt(wrong)]
+            gold = 0
+            if rng.random() < 0.5:
+                choices.reverse()
+                gold = 1
+            rows.append({"query": fmt(tmpl), "choices": choices, "gold": gold})
+    rng.shuffle(rows)
+    return rows[:220]
+
+
+def gen_strategy_qa(rng: random.Random) -> list[dict]:
+    bank = [
+        ("Could a person carry a horse in a backpack?", "no"),
+        ("Can you see the Moon from Earth on a clear night?", "yes"),
+        ("Would an ice cube survive a week in a hot oven?", "no"),
+        ("Can a fish ride a bicycle?", "no"),
+        ("Do trees need sunlight to grow?", "yes"),
+        ("Could you fit an elephant inside a teacup?", "no"),
+        ("Can water be frozen into ice in a home freezer?", "yes"),
+        ("Would a paper boat last longer than a steel boat in water?", "no"),
+        ("Do humans need to breathe air to live?", "yes"),
+        ("Could a candle stay lit underwater?", "no"),
+        ("Can a letter be sent through the postal service?", "yes"),
+        ("Would a snowman last all summer on a tropical beach?", "no"),
+        ("Do birds lay eggs?", "yes"),
+        ("Could one person eat a thousand dinners in one evening?", "no"),
+        ("Can a key that fits the lock open that lock?", "yes"),
+        ("Would a feather fall as fast as a hammer in a vacuum?", "yes"),
+        ("Can a dog learn to respond to simple commands?", "yes"),
+        ("Could you walk from Europe to Australia entirely on land?", "no"),
+        ("Does bread usually contain flour?", "yes"),
+        ("Can the same water be boiled after it has cooled?", "yes"),
+    ]
+    rows = []
+    for q, ans in bank:
+        for prefix in ("", "Think carefully: ", "A quiz asks: ", "True reasoning: ",
+                       "Answer yes or no. ", "Consider physics and common sense: ",
+                       "Q: ", "Strategy question: ", "Honestly, ", "In the real world, "):
+            choices = ["yes", "no"]
+            rows.append({"query": f"{prefix}{q}", "choices": choices,
+                         "gold": choices.index(ans)})
+    rng.shuffle(rows)
+    return rows[:200]
+
+
+# ---------------------------------------------------------------------------
+# language_understanding
+# ---------------------------------------------------------------------------
+
+
+def gen_lambada(rng: random.Random) -> list[dict]:
+    """Final-word prediction where the target word appears earlier in the
+    passage (the defining LAMBADA property)."""
+    templates = [
+        ("{a} packed the {o} carefully in a box. At the post office the clerk weighed the box, "
+         "printed a label, and promised the {o} would arrive by Friday. When {b} opened the box, "
+         "inside was the", " {o}"),
+        ("The {an} followed {a} all the way from the {p}. {a} stopped, and the {an} stopped too. "
+         "At the gate {a} turned around and finally petted the", " {an}"),
+        ("{a} spent all morning baking a {f} pie. The smell drifted through the house, and by "
+         "noon everyone gathered in the kitchen asking for a slice of the {f}", " pie"),
+        ("{a} left the {o} on the bench at the {p}. Hours later, remembering suddenly, {a} "
+         "ran back to the {p} hoping someone had not taken the", " {o}"),
+        ("Every evening {a} read one chapter to {b}. Tonight the power went out, so {a} lit a "
+         "candle, opened the book, and kept reading to", " {b}"),
+        ("The {an} at the {p} would only eat {f}. Visitors offered bread and seeds, but the "
+         "keeper smiled and handed over a piece of", " {f}"),
+        ("{a} and {b} raced to the {p}. {b} tripped near the fountain, so the first to touch "
+         "the gate was", " {a}"),
+        ("The old {o} had belonged to {a}'s grandmother. Now, polished and repaired, on the "
+         "shelf stood the same", " {o}"),
+    ]
+    rows = []
+    for ctx_t, cont_t in templates:
+        for _ in range(40):
+            a, b = rng.sample(FIRST_NAMES, 2)
+            sub = {"a": a, "b": b, "o": rng.choice(OBJECTS), "an": rng.choice(ANIMALS),
+                   "f": rng.choice(FOODS), "p": rng.choice(PLACES)}
+            rows.append({"context": ctx_t.format(**sub), "continuation": cont_t.format(**sub)})
+    rng.shuffle(rows)
+    return rows[:300]
+
+
+def gen_hellaswag(rng: random.Random) -> list[dict]:
+    bank = [
+        ("{a} fills a kettle with water and puts it on the stove. Then {a}",
+         "waits for the water to boil and pours it into a mug",
+         ["plants the kettle in the garden", "mails the stove to a friend",
+          "paints the water blue before drinking the stove"]),
+        ("{a} laces up both running shoes at the park. Then {a}",
+         "starts jogging along the path",
+         ["removes the shoes and eats the laces", "buries the shoes under the bench",
+          "throws the shoes into the pond and walks home barefoot backwards"]),
+        ("{a} spreads a cloth on the grass and opens a picnic basket. Then {a}",
+         "lays out sandwiches and fruit for lunch",
+         ["folds the grass into the basket", "sets the cloth on fire for warmth",
+          "locks the basket and swims away"]),
+        ("{a} picks up a brush and dips it in red paint. Then {a}",
+         "makes careful strokes on the canvas",
+         ["drinks the paint slowly", "brushes the cat's teeth with it",
+          "plants the brush hoping it grows"]),
+        ("{a} shovels snow off the driveway for an hour. Then {a}",
+         "leans the shovel by the door and goes inside to warm up",
+         ["spreads the snow back evenly", "mails the driveway away",
+          "freezes the shovel in the pond"]),
+        ("{a} whisks eggs in a bowl and heats a pan with butter. Then {a}",
+         "pours the eggs into the pan to make an omelet",
+         ["pours the eggs into a shoe", "freezes the hot pan immediately",
+          "feeds the butter back to the cow"]),
+        ("{a} tunes the guitar and sits on a stool by the microphone. Then {a}",
+         "begins to play a song for the audience",
+         ["unstrings the guitar and leaves", "eats the microphone",
+          "tunes the audience instead"]),
+        ("{a} loads the washing machine and adds detergent. Then {a}",
+         "starts the wash cycle and closes the lid",
+         ["climbs into the machine with a book", "adds a bucket of sand",
+          "hangs the machine on the clothesline"]),
+    ]
+    rows = []
+    for tmpl, correct, wrong in bank:
+        for _ in range(30):
+            a = rng.choice(FIRST_NAMES)
+            fmt = lambda s: s.format(a=a)  # noqa: E731
+            rows.append(_mc(fmt(tmpl), fmt(correct), [fmt(w) for w in wrong], rng))
+    rng.shuffle(rows)
+    return rows[:240]
+
+
+_SCHEMA_BANK = [
+    # (option_a_entity, option_b_entity, sentence-template with {e}, continuation, gold_entity)
+    ("the trophy", "the suitcase", "{e} was too large, so", " it did not fit", 0),
+    ("the ball", "the table", "{e} rolled off the edge because", " it was round", 0),
+    ("the ice", "the stove", "{e} melted quickly on", " the hot surface", 0),
+    ("the nail", "the balloon", "{e} popped when they touched because", " it was sharp", 0),
+    ("the book", "the shelf", "{e} was too heavy for", " the thin boards", 0),
+    ("the key", "the lock", "{e} was bent, so", " it would not turn", 0),
+    ("the dog", "the gate", "{e} barked all night because", " it heard noises", 0),
+    ("the river", "the bridge", "{e} flooded in spring, covering", " the road", 0),
+    ("the candle", "the fan", "{e} went out when", " the air moved", 0),
+    ("the glass", "the counter", "{e} shattered when it fell off", " the edge", 0),
+]
+
+
+def _gen_schema(rng: random.Random, n: int) -> list[dict]:
+    rows = []
+    for a_ent, b_ent, tmpl, cont, gold in _SCHEMA_BANK:
+        for _ in range(n):
+            opts = [tmpl.format(e=a_ent.capitalize()), tmpl.format(e=b_ent.capitalize())]
+            rows.append({"context_options": opts, "continuation": cont, "gold": gold})
+    rng.shuffle(rows)
+    return rows
+
+
+def gen_winograd(rng: random.Random) -> list[dict]:
+    return _gen_schema(rng, 12)[:110]
+
+
+def gen_winogrande(rng: random.Random) -> list[dict]:
+    # name-substituted variant bank for variety vs winograd
+    rows = []
+    verbs = [("watered", "the plant", "the bucket", " every morning"),
+             ("sharpened", "the pencil", "the eraser", " before class"),
+             ("locked", "the door", "the window", " at night"),
+             ("folded", "the shirt", "the hanger", " neatly"),
+             ("peeled", "the orange", "the bowl", " for breakfast")]
+    for verb, obj_a, obj_b, cont in verbs:
+        for name in FIRST_NAMES:
+            opts = [f"{name} {verb} {obj_a}", f"{name} {verb} {obj_b}"]
+            rows.append({"context_options": opts, "continuation": cont, "gold": 0})
+    rng.shuffle(rows)
+    return rows[:130] + _gen_schema(rng, 7)[:70]
+
+
+# ---------------------------------------------------------------------------
+# symbolic_problem_solving (programmatic — the real tasks)
+# ---------------------------------------------------------------------------
+
+
+def gen_arithmetic(rng: random.Random, spaces: bool) -> list[dict]:
+    rows = []
+    for _ in range(300):
+        a, b = rng.randint(0, 99), rng.randint(0, 99)
+        op = rng.choice(["+", "-"])
+        val = a + b if op == "+" else a - b
+        if spaces:
+            rows.append({"context": f"{a} {op} {b} =", "continuation": f" {val}"})
+        else:
+            rows.append({"context": f"{a}{op}{b}=", "continuation": f"{val}"})
+    return rows
+
+
+def gen_dyck(rng: random.Random) -> list[dict]:
+    pairs = {"(": ")", "[": "]", "{": "}"}
+    rows = []
+    for _ in range(300):
+        depth = rng.randint(2, 6)
+        opens = [rng.choice(list(pairs)) for _ in range(depth)]
+        seq: list[str] = []
+        stack: list[str] = []
+        for o in opens:
+            seq.append(o)
+            stack.append(o)
+            # sometimes close one early to vary structure
+            if stack and rng.random() < 0.35:
+                seq.append(pairs[stack.pop()])
+        closing = "".join(pairs[o] for o in reversed(stack))
+        if not closing:
+            continue
+        rows.append({
+            "context": "Complete the sequence so every bracket is closed: " + " ".join(seq),
+            "continuation": " " + " ".join(closing),
+        })
+    return rows
+
+
+def gen_operators(rng: random.Random) -> list[dict]:
+    defs = [
+        ("x op y = x + 2 * y", lambda x, y: x + 2 * y),
+        ("x op y = 2 * x - y", lambda x, y: 2 * x - y),
+        ("x op y = x * y + 1", lambda x, y: x * y + 1),
+        ("x op y = x + y + 10", lambda x, y: x + y + 10),
+        ("x op y = x * 3 - y", lambda x, y: 3 * x - y),
+        ("x op y = (x + y) * 2", lambda x, y: (x + y) * 2),
+    ]
+    rows = []
+    for _ in range(300):
+        desc, fn = rng.choice(defs)
+        x, y = rng.randint(1, 20), rng.randint(1, 20)
+        rows.append({"context": f"Define {desc}. Then {x} op {y} =",
+                     "continuation": f" {fn(x, y)}"})
+    return rows
+
+
+def gen_cs_algorithms(rng: random.Random) -> list[dict]:
+    rows = []
+    # subtask 1: balanced-parentheses validity (the real bigbench subtask)
+    for _ in range(150):
+        n = rng.randint(4, 10)
+        seq = [rng.choice("()[]") for _ in range(n)]
+        stack: list[str] = []
+        valid = True
+        for c in seq:
+            if c in "([":
+                stack.append(c)
+            else:
+                if not stack or {"(": ")", "[": "]"}[stack.pop()] != c:
+                    valid = False
+                    break
+        valid = valid and not stack
+        rows.append({
+            "context": "Is the bracket sequence valid? Sequence: " + "".join(seq) + "\nAnswer:",
+            "continuation": " valid" if valid else " invalid",
+        })
+    # subtask 2: longest common subsequence length
+    for _ in range(150):
+        a = "".join(rng.choice("abcd") for _ in range(rng.randint(3, 6)))
+        b = "".join(rng.choice("abcd") for _ in range(rng.randint(3, 6)))
+        dp = [[0] * (len(b) + 1) for _ in range(len(a) + 1)]
+        for i in range(len(a)):
+            for j in range(len(b)):
+                dp[i + 1][j + 1] = (dp[i][j] + 1 if a[i] == b[j]
+                                    else max(dp[i][j + 1], dp[i + 1][j]))
+        rows.append({
+            "context": f"Length of the longest common subsequence of '{a}' and '{b}':",
+            "continuation": f" {dp[len(a)][len(b)]}",
+        })
+    rng.shuffle(rows)
+    return rows
+
+
+def gen_elementary_math_qa(rng: random.Random) -> list[dict]:
+    rows = []
+    for _ in range(300):
+        kind = rng.randrange(3)
+        if kind == 0:
+            n, price = rng.randint(2, 9), rng.randint(2, 9)
+            q = f"A shop sells pencils at {price} cents each. How many cents do {n} pencils cost?"
+            correct = n * price
+        elif kind == 1:
+            total, eaten = rng.randint(10, 30), rng.randint(1, 9)
+            q = f"A plate holds {total} cookies. {eaten} are eaten. How many cookies remain?"
+            correct = total - eaten
+        else:
+            groups, per = rng.randint(2, 9), rng.randint(2, 9)
+            q = f"There are {groups} baskets with {per} apples in each. How many apples in total?"
+            correct = groups * per
+        wrong = {correct + rng.randint(1, 5), max(0, correct - rng.randint(1, 5)),
+                 correct + 10}
+        wrong.discard(correct)
+        rows.append(_mc(q, str(correct), [str(w) for w in sorted(wrong)][:3], rng))
+    return rows
+
+
+def gen_gsm8k(rng: random.Random) -> list[dict]:
+    rows = []
+    for _ in range(200):
+        a_n, b_n = rng.sample(FIRST_NAMES, 2)
+        x, y, z = rng.randint(2, 12), rng.randint(2, 12), rng.randint(2, 6)
+        kind = rng.randrange(3)
+        if kind == 0:
+            ans = x * y + z
+            q = (f"{a_n} buys {x} boxes of {rng.choice(FOODS)}s with {y} in each box, "
+                 f"then finds {z} more. How many does {a_n} have in total?")
+        elif kind == 1:
+            ans = (x + y) * z
+            q = (f"{a_n} has {x} marbles and {b_n} has {y}. They pool them and then "
+                 f"{z} friends each bring the same pooled amount again. Including the "
+                 f"original pool, how many marbles are there in total across the "
+                 f"{z + 1} pools?")
+            ans = (x + y) * (z + 1)
+        else:
+            ans = x * y - z
+            q = (f"A farmer plants {x} rows of {y} seedlings. {z} seedlings do not "
+                 "survive. How many seedlings survive?")
+        rows.append({"context": f"Question: {q}", "answer": str(ans), "aliases": []})
+    return rows
+
+
+def gen_svamp(rng: random.Random) -> list[dict]:
+    rows = []
+    for _ in range(200):
+        name = rng.choice(FIRST_NAMES)
+        x, y = rng.randint(5, 60), rng.randint(1, 40)
+        if rng.random() < 0.5:
+            q = f"{name} had {x} {rng.choice(OBJECTS)}s and gave away {y}. How many are left?"
+            ans = x - y
+        else:
+            q = f"{name} had {x} {rng.choice(FOODS)}s and bought {y} more. How many now?"
+            ans = x + y
+        rows.append({"context": q, "answer": str(ans), "aliases": []})
+    return rows
+
+
+def gen_lsat_ar(rng: random.Random) -> list[dict]:
+    """Ordering puzzles — the analytical-reasoning core, fully programmatic."""
+    rows = []
+    ordinals = ["first", "second", "third", "fourth", "fifth"]
+    for _ in range(200):
+        people = rng.sample(FIRST_NAMES, 4)
+        order = people[:]
+        rng.shuffle(order)
+        clues = [f"{order[0]} finishes before everyone else.",
+                 f"{order[1]} finishes immediately after {order[0]}.",
+                 f"{order[3]} finishes last."]
+        pos = rng.randrange(4)
+        q = (f"Four runners finish a race. {' '.join(clues)} "
+             f"Who finishes {ordinals[pos]}?")
+        correct = order[pos]
+        rows.append(_mc(q, correct, [p for p in order if p != correct][:3], rng))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# reading_comprehension
+# ---------------------------------------------------------------------------
+
+
+def _passage(rng: random.Random) -> tuple[str, list[tuple[str, str]]]:
+    """A small generated passage + (question, answer-word) pairs about it."""
+    a, b = rng.sample(FIRST_NAMES, 2)
+    obj, place, food, animal = (rng.choice(OBJECTS), rng.choice(PLACES),
+                                rng.choice(FOODS), rng.choice(ANIMALS))
+    day = rng.choice(["Monday", "Tuesday", "Wednesday", "Thursday", "Friday"])
+    passage = (
+        f"On {day} morning, {a} walked to the {place} carrying a {obj}. "
+        f"At the gate, {a} met {b}, who was feeding a {animal}. "
+        f"They shared a {food} and agreed to meet again next {day}."
+    )
+    qas = [
+        (f"Where did {a} walk to?", place),
+        (f"What was {a} carrying?", obj),
+        (f"Who was feeding the {animal}?", b),
+        (f"What did they share?", food),
+        (f"On which day did this happen?", day),
+    ]
+    return passage, qas
+
+
+def gen_squad(rng: random.Random) -> list[dict]:
+    rows = []
+    for _ in range(80):
+        passage, qas = _passage(rng)
+        for q, ans in rng.sample(qas, 3):
+            rows.append({"context": f"{passage}\nQuestion: {q}\nAnswer:",
+                         "continuation": f" {ans}"})
+    rng.shuffle(rows)
+    return rows[:240]
+
+
+def gen_coqa(rng: random.Random) -> list[dict]:
+    rows = []
+    for _ in range(200):
+        passage, qas = _passage(rng)
+        (q1, a1), (q2, a2) = rng.sample(qas, 2)
+        rows.append({
+            "context": f"{passage}\nQ: {q1}\nA: {a1}\nQ: {q2}\nA:",
+            "continuation": f" {a2}",
+        })
+    rng.shuffle(rows)
+    return rows[:200]
+
+
+def gen_boolq(rng: random.Random) -> list[dict]:
+    rows = []
+    for _ in range(200):
+        passage, qas = _passage(rng)
+        q, ans = rng.choice(qas)
+        truthy = rng.random() < 0.5
+        if truthy:
+            yn_q = f"{q.rstrip('?')} — is it the {ans}?" if not ans[0].isupper() else \
+                f"{q.rstrip('?')} — is it {ans}?"
+            gold = "yes"
+        else:
+            pool = OBJECTS + PLACES + FOODS + FIRST_NAMES
+            wrong = rng.choice([w for w in pool if w != ans])
+            yn_q = f"{q.rstrip('?')} — is it the {wrong}?" if not wrong[0].isupper() else \
+                f"{q.rstrip('?')} — is it {wrong}?"
+            gold = "no"
+        choices = ["yes", "no"]
+        rows.append({"query": f"{passage}\n{yn_q}", "choices": choices,
+                     "gold": choices.index(gold)})
+    return rows
+
+
+def gen_lsat_rc(rng: random.Random) -> list[dict]:
+    rows = []
+    for _ in range(150):
+        passage, qas = _passage(rng)
+        q, ans = rng.choice(qas)
+        pool = list({*OBJECTS, *PLACES, *FOODS, *FIRST_NAMES} - {ans})
+        rows.append(_mc(f"{passage}\nAccording to the passage, {q.lower()}",
+                        ans, rng.sample(pool, 3), rng))
+    return rows
+
+
+def gen_lsat_lr(rng: random.Random) -> list[dict]:
+    rows = []
+    for _ in range(150):
+        a = rng.choice(FIRST_NAMES)
+        animal = rng.choice(ANIMALS)
+        p1 = f"All {animal}s at the farm are friendly."
+        p2 = f"{a}'s pet is a {animal} from the farm."
+        q = f"{p1} {p2} What follows?"
+        correct = f"{a}'s pet is friendly"
+        wrong = [f"{a}'s pet is not from the farm",
+                 f"No {animal} is friendly",
+                 f"{a} has never seen the pet"]
+        rows.append(_mc(q, correct, wrong, rng))
+    return rows
+
+
+def gen_sat_en(rng: random.Random) -> list[dict]:
+    rows = []
+    for _ in range(150):
+        passage, qas = _passage(rng)
+        pool = ["a trip to the market", "an argument about weather",
+                "a cooking contest", "a friendly meeting", "a lost letter"]
+        rows.append(_mc(
+            f"{passage}\nThe passage mainly describes",
+            "a friendly meeting",
+            [p for p in pool if p != "a friendly meeting"][:3],
+            rng,
+        ))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# corpus assembly
+# ---------------------------------------------------------------------------
+
+# (relative path, generator, needs_challenge_flag)
+CORPUS = {
+    "world_knowledge/jeopardy_all.jsonl": gen_jeopardy,
+    "world_knowledge/bigbench_qa_wikidata.jsonl": gen_qa_wikidata,
+    "world_knowledge/arc_easy.jsonl": lambda r: gen_arc(r, challenge=False),
+    "world_knowledge/arc_challenge.jsonl": lambda r: gen_arc(r, challenge=True),
+    "world_knowledge/mmlu.jsonl": gen_mmlu,
+    "world_knowledge/triviaqa_sm_sub.jsonl": gen_triviaqa,
+    "commonsense_reasoning/copa.jsonl": gen_copa,
+    "commonsense_reasoning/siqa.jsonl": gen_siqa,
+    "commonsense_reasoning/commonsense_qa.jsonl": gen_commonsense_qa,
+    "commonsense_reasoning/piqa.jsonl": gen_piqa,
+    "commonsense_reasoning/openbook_qa.jsonl": gen_openbook_qa,
+    "commonsense_reasoning/bigbench_strange_stories.jsonl": gen_strange_stories,
+    "commonsense_reasoning/bigbench_strategy_qa.jsonl": gen_strategy_qa,
+    "language_understanding/lambada_openai.jsonl": gen_lambada,
+    "language_understanding/hellaswag.jsonl": gen_hellaswag,
+    "language_understanding/winograd_wsc.jsonl": gen_winograd,
+    "language_understanding/winogrande.jsonl": gen_winogrande,
+    "symbolic_problem_solving/simple_arithmetic_withspaces.jsonl":
+        lambda r: gen_arithmetic(r, spaces=True),
+    "symbolic_problem_solving/simple_arithmetic_nospaces.jsonl":
+        lambda r: gen_arithmetic(r, spaces=False),
+    "symbolic_problem_solving/bigbench_dyck_languages.jsonl": gen_dyck,
+    "symbolic_problem_solving/bigbench_operators.jsonl": gen_operators,
+    "symbolic_problem_solving/bigbench_cs_algorithms.jsonl": gen_cs_algorithms,
+    "symbolic_problem_solving/bigbench_elementary_math_qa.jsonl": gen_elementary_math_qa,
+    "symbolic_problem_solving/gsm8k_prepended_8shot.jsonl": gen_gsm8k,
+    "symbolic_problem_solving/svamp.jsonl": gen_svamp,
+    "symbolic_problem_solving/agi_eval_lsat_ar.jsonl": gen_lsat_ar,
+    "reading_comprehension/squad.jsonl": gen_squad,
+    "reading_comprehension/coqa.jsonl": gen_coqa,
+    "reading_comprehension/boolq.jsonl": gen_boolq,
+    "reading_comprehension/agi_eval_lsat_rc.jsonl": gen_lsat_rc,
+    "reading_comprehension/agi_eval_lsat_lr.jsonl": gen_lsat_lr,
+    "reading_comprehension/agi_eval_sat_en.jsonl": gen_sat_en,
+}
+
+
+def build(out_dir: pathlib.Path, seed: int = 0) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for rel, gen in CORPUS.items():
+        rng = random.Random(f"{seed}:{rel}")
+        rows = gen(rng)
+        path = out_dir / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with path.open("w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        counts[rel] = len(rows)
+    return counts
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=str(HERE / "local_data"))
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    counts = build(pathlib.Path(args.out), args.seed)
+    total = sum(counts.values())
+    for rel, n in sorted(counts.items()):
+        print(f"{n:5d}  {rel}")
+    print(f"{total:5d}  TOTAL ({len(counts)} tasks)")
+
+
+if __name__ == "__main__":
+    main()

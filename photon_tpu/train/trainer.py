@@ -16,6 +16,7 @@ reference's FSDP gather/scatter at round boundaries (``utils.py:247-319``).
 from __future__ import annotations
 
 import time
+import warnings
 from typing import Any, Callable, Iterable, Iterator
 
 import jax
@@ -85,8 +86,6 @@ class Trainer:
         gbs = cfg.train.global_batch_size
         if gbs % dp_degree:
             adapted = max((gbs // dp_degree) * dp_degree, dp_degree)
-            import warnings
-
             warnings.warn(
                 f"global_batch_size {gbs} not divisible by data-parallel degree "
                 f"{dp_degree}; adapted to {adapted}",
@@ -105,8 +104,6 @@ class Trainer:
             # run one oversized scan chunk — clamp it to the batch
             clamped = min(micro, cfg.train.global_batch_size // dp_degree)
             if clamped != micro:
-                import warnings
-
                 warnings.warn(
                     f"device_microbatch_size {micro} exceeds the per-device "
                     f"batch {cfg.train.global_batch_size // dp_degree}; "
@@ -124,8 +121,6 @@ class Trainer:
                 (cfg.train.global_batch_size // rows_per_scan) * rows_per_scan,
                 rows_per_scan,
             )
-            import warnings
-
             warnings.warn(
                 f"global_batch_size {cfg.train.global_batch_size} not divisible "
                 f"by microbatch rows-per-scan {rows_per_scan} "

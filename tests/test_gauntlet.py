@@ -37,7 +37,8 @@ def test_parse_reference_task_suite_format():
     assert arc.num_fewshot == (2,)
     assert arc.continuation_delimiter == "\nAnswer: "
     gsm = next(s for s in suite.specs if s.label == "gsm_demo")
-    assert not gsm.scoreable  # generation tasks are out of logprob scope
+    assert gsm.scoreable  # generation tasks score via batched greedy decode
+    assert gsm.cot_delimiter == 'The answer is '
 
 
 def test_parse_reference_gauntlet_format():
@@ -57,11 +58,11 @@ def test_parse_reference_gauntlet_format():
     }
 
 
-def test_suite_load_skips_generation_tasks():
+def test_suite_loads_all_four_task_types():
     suite = TaskSuite.from_yaml(CONFIGS / "tasks_demo.yaml")
     tasks, skipped = suite.load_tasks()
-    assert {t.name for t in tasks} == {"arc_demo", "copa_demo", "lambada_demo"}
-    assert skipped == ["gsm_demo (generation_task_with_answers)"]
+    assert {t.name for t in tasks} == {"arc_demo", "copa_demo", "lambada_demo", "gsm_demo"}
+    assert skipped == []
 
 
 def test_suite_type_mismatch_raises(tmp_path):
@@ -165,5 +166,6 @@ def test_demo_corpus_end_to_end():
         "gauntlet/average",
     ):
         assert key in out, key
-    assert out["gauntlet/skipped_tasks"] == 1.0  # gsm_demo (generation)
+    assert "gauntlet/skipped_tasks" not in out  # all four types score now
+    assert "icl/gsm_demo/accuracy" in out
     assert 0.0 <= out["icl/arc_demo/accuracy"] <= 1.0
