@@ -159,6 +159,12 @@ class DatasetConfig:
     split_eval: str = "val"
     shuffle: bool = True
     shuffle_seed: int = 17
+    # stream remapping (reference: ``set_stream`` assigns ``streams[cid % n]``,
+    # ``photon/clients/llm_config_functions.py:388-436``): with n_streams > 0,
+    # client cid reads ``client_{cid % n_streams}/{split}`` so more clients
+    # than converted streams (or deliberate stream sharing) works; 0 keeps
+    # the 1:1 ``client_{cid}`` layout from the conversion pipeline
+    n_streams: int = 0
     # (no num_canonical_nodes analog: the reference needs it to keep MDS data
     # order invariant to physical node count; here every client cid owns its
     # own resumable loader, so order is node-count-invariant by construction)
@@ -203,6 +209,10 @@ class FLConfig:
     ignore_failed_rounds: bool = False
     eval_interval_rounds: int = 0
     sample_seed: int = 1234
+    # sliding-window reply timeouts (seconds); previously hardcoded 3600 —
+    # a wedged node stalled a round for an hour with no knob (VERDICT r3)
+    fit_timeout_s: float = 3600.0
+    eval_timeout_s: float = 3600.0
     # per-round client config knobs (reference FitConfig: reset_optimizer,
     # reset_dataset_state, client_checkpoints, ... — ``clients/configs.py:55-214``)
     fit_config: dict = field(default_factory=dict)

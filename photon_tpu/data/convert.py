@@ -128,6 +128,10 @@ def main(argv: list[str] | None = None) -> None:
     ap = argparse.ArgumentParser(description="Convert a corpus to per-client PTS shards")
     src = ap.add_mutually_exclusive_group(required=True)
     src.add_argument("--hf-dataset", help="HF dataset name (e.g. allenai/c4)")
+    src.add_argument("--dataset-key",
+                     help="mC4 registry key (c4_en … c4_hi, data/constants.py); "
+                          "resolves HF path/config/split + truncation from the "
+                          "reference's language table")
     src.add_argument("--text-files", nargs="+", help="local .txt/.jsonl files, one doc per line")
     ap.add_argument("--hf-config", default=None)
     ap.add_argument("--hf-split", default="train")
@@ -143,11 +147,20 @@ def main(argv: list[str] | None = None) -> None:
     from photon_tpu.data.tokenizer import load_tokenizer
 
     tok = load_tokenizer(args.tokenizer)
-    docs = (
-        iter_hf_dataset(args.hf_dataset, args.hf_config, args.hf_split)
-        if args.hf_dataset
-        else iter_text_files(args.text_files)
-    )
+    if args.dataset_key:
+        from photon_tpu.data.constants import resolve_split
+
+        consts = resolve_split(args.dataset_key, args.hf_split)
+        docs = iter_hf_dataset(consts.path, consts.name, consts.split)
+        if consts.truncated_samples is not None:
+            import itertools
+
+            docs = itertools.islice(docs, consts.truncated_samples)
+        args.split = consts.folder_split if args.split == "train" else args.split
+    elif args.hf_dataset:
+        docs = iter_hf_dataset(args.hf_dataset, args.hf_config, args.hf_split)
+    else:
+        docs = iter_text_files(args.text_files)
     summary = convert_corpus(
         docs,
         args.out,

@@ -81,9 +81,30 @@ def _conv_winogrande(row):
             "gold": int(row["answer"]) - 1}
 
 
+_GSM8K_SHOTS: list[str] = []  # filled lazily from the train split
+
+
 def _conv_gsm8k(row):
+    """gsm8k_prepended_8shot: the reference file carries 8 chain-of-thought
+    train examples PREPENDED to every test question (which is why
+    tasks_v0.3.yaml pins gsm8k at num_fewshot [0]); reproduce that here."""
     answer = row["answer"].split("####")[-1].strip()
-    return {"context": f"Question: {row['question']}", "answer": answer, "aliases": []}
+    prefix = "".join(_GSM8K_SHOTS)
+    return {"context": f"{prefix}Question: {row['question']}",
+            "answer": answer, "aliases": []}
+
+
+def _prime_gsm8k_shots() -> None:
+    import datasets
+
+    train = datasets.load_dataset("openai/gsm8k", "main", split="train")
+    del _GSM8K_SHOTS[:]
+    for row in list(train)[:8]:
+        cot, _, final = row["answer"].partition("####")
+        _GSM8K_SHOTS.append(
+            f"Question: {row['question']}\n\nA:{cot.strip()}\n"
+            f"The answer is {final.strip()}\n\n"
+        )
 
 
 def _conv_triviaqa(row):
@@ -156,6 +177,8 @@ def fetch(out_dir: pathlib.Path, only: list[str] | None = None,
     for label, (rel, load_kw, conv) in FETCHERS.items():
         if only and label not in only:
             continue
+        if label == "gsm8k" and not _GSM8K_SHOTS:
+            _prime_gsm8k_shots()
         ds = datasets.load_dataset(**load_kw)
         rows = []
         for row in ds:

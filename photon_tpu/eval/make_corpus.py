@@ -92,12 +92,11 @@ def gen_arc(rng: random.Random, challenge: bool) -> list[dict]:
         rows.append(_mc(q, correct, wrong, rng))
         rows.append(_mc(f"Science quiz. {q}", correct, wrong, rng))
         if challenge:
-            # harder variant: negated phrasing, same fact bank
+            # harder variant: the question embedded in a two-step setting
             rows.append(_mc(
-                f"Which of the following is NOT true? Consider: {q}",
-                f"the answer is {wrong[0]}",
-                [f"the answer is {correct}"] + [f"the answer could be {w}" for w in wrong[1:]],
-                rng,
+                f"A student answers '{wrong[0]}' to the question: {q} "
+                "What would the correct answer have been?",
+                correct, wrong, rng,
             ))
     for name, symbol, number in ELEMENTS:
         wrong_sym = [s for _, s, _ in ELEMENTS if s != symbol]
@@ -685,7 +684,6 @@ def gen_gsm8k(rng: random.Random) -> list[dict]:
             q = (f"{a_n} buys {x} boxes of {rng.choice(FOODS)}s with {y} in each box, "
                  f"then finds {z} more. How many does {a_n} have in total?")
         elif kind == 1:
-            ans = (x + y) * z
             q = (f"{a_n} has {x} marbles and {b_n} has {y}. They pool them and then "
                  f"{z} friends each bring the same pooled amount again. Including the "
                  f"original pool, how many marbles are there in total across the "
@@ -705,6 +703,7 @@ def gen_svamp(rng: random.Random) -> list[dict]:
         name = rng.choice(FIRST_NAMES)
         x, y = rng.randint(5, 60), rng.randint(1, 40)
         if rng.random() < 0.5:
+            y = min(y, x - 1)  # can't give away more than held
             q = f"{name} had {x} {rng.choice(OBJECTS)}s and gave away {y}. How many are left?"
             ans = x - y
         else:

@@ -96,9 +96,11 @@ class ClientRuntime:
                     )
                 ds = ShardedDataset(root)
             else:
-                # reference stream assignment: streams[cid % n] — here the
-                # conversion pipeline wrote client_{cid}/{split} directly
-                ds = ShardedDataset(pathlib.Path(ds_cfg.local_path) / f"client_{cid}" / split)
+                # reference stream assignment: streams[cid % n]
+                # (``llm_config_functions.py:388-436``); n_streams=0 keeps the
+                # 1:1 client_{cid} layout from the conversion pipeline
+                stream = cid % ds_cfg.n_streams if ds_cfg.n_streams > 0 else cid
+                ds = ShardedDataset(pathlib.Path(ds_cfg.local_path) / f"client_{stream}" / split)
             self._loaders[key] = StreamingLoader(
                 ds,
                 batch_size=batch_size,
@@ -120,6 +122,15 @@ class ClientRuntime:
             raise RuntimeError("no parameters: neither FitIns pointer nor prior broadcast")
         return self._current_params
 
+    def _error_with_oom_dump(self, e: Exception, tag: str) -> str:
+        """Error string for a failed fit/eval; on OOM, writes the device
+        memory profile to save_path and references it (the
+        MemorySnapshot/OOMObserver analog, ``trainer_utils.py:721-729``)."""
+        from photon_tpu.utils.profiling import dump_memory_profile, is_oom
+
+        dump = dump_memory_profile(self.cfg.photon.save_path, tag) if is_oom(e) else None
+        return f"{type(e).__name__}: {e}" + (f" [memory profile: {dump}]" if dump else "")
+
     # -- fit -------------------------------------------------------------
     def fit(self, ins: FitIns, cid: int) -> FitRes:
         t_start = time.monotonic()
@@ -127,10 +138,12 @@ class ClientRuntime:
             return self._fit_inner(ins, cid, t_start)
         except Exception as e:  # noqa: BLE001 — worker-level failure isolation
             # reference: exception → error result so the node can retry the
-            # cid elsewhere (``worker.py:427-448``)
+            # cid elsewhere (``worker.py:427-448``); on OOM also dump the
+            # device memory profile (MemorySnapshot/OOMObserver analog,
+            # ``trainer_utils.py:721-729``)
             return FitRes(
                 server_round=ins.server_round, cid=cid, params=None,
-                error=f"{type(e).__name__}: {e}",
+                error=self._error_with_oom_dump(e, f"fit_cid{cid}"),
             )
 
     def _fit_inner(self, ins: FitIns, cid: int, t_start: float) -> FitRes:
@@ -306,7 +319,8 @@ class ClientRuntime:
             )
         except Exception as e:  # noqa: BLE001
             return EvaluateRes(
-                server_round=ins.server_round, cid=cid, error=f"{type(e).__name__}: {e}"
+                server_round=ins.server_round, cid=cid,
+                error=self._error_with_oom_dump(e, f"eval_cid{cid}"),
             )
 
     def _unigram_metrics(

@@ -330,7 +330,7 @@ class ServerApp:
         per_client_n: list[int] = []
 
         def results() -> Iterator[ClientResult]:
-            for res in self._sliding_window(server_round, cids, make_ins, timeout=3600.0):
+            for res in self._sliding_window(server_round, cids, make_ins, timeout=self.cfg.fl.fit_timeout_s):
                 assert isinstance(res, FitRes)
                 _, arrays = self.transport.get(res.params)
                 if res.client_state:
@@ -370,7 +370,7 @@ class ServerApp:
             )
 
         results = []
-        for res in self._sliding_window(server_round, cids, make_ins, timeout=3600.0):
+        for res in self._sliding_window(server_round, cids, make_ins, timeout=self.cfg.fl.eval_timeout_s):
             assert isinstance(res, EvaluateRes)
             results.append((res.n_samples, res.loss, res.metrics))
         loss, metrics = self.strategy.aggregate_evaluate(server_round, results)

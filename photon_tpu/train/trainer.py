@@ -174,8 +174,9 @@ class Trainer:
 
     @staticmethod
     def _is_oom(e: Exception) -> bool:
-        msg = str(e)
-        return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "out of memory" in msg
+        from photon_tpu.utils.profiling import is_oom
+
+        return is_oom(e)
 
     def _probe_microbatch(self, host_state: TrainState, dp_degree: int):
         """Largest power-of-2 per-device microbatch that compiles AND executes
@@ -247,8 +248,15 @@ class Trainer:
                 f"global_batch_size={cfg.train.global_batch_size} over "
                 f"dp_degree={dp_degree}; set device_microbatch_size explicitly"
             )
+        from photon_tpu.utils.profiling import dump_memory_profile
+
+        dump = dump_memory_profile(
+            getattr(cfg.photon, "save_path", ".") or ".", "auto_microbatch"
+        )
         raise RuntimeError(
-            f"auto microbatch: even microbatch 1 exhausts device memory: {last_err}"
+            f"auto microbatch: even microbatch 1 exhausts device memory"
+            + (f" (memory profile: {dump})" if dump else "")
+            + f": {last_err}"
         )
 
     # ------------------------------------------------------------------

@@ -45,6 +45,35 @@ def peak_flops_for_device_kind(kind: str, default: float = TPU_V5E_PEAK_FLOPS) -
     return next((p for sub, p in PEAK_FLOPS_BY_DEVICE_KIND if sub in kind), default)
 
 
+def is_oom(e: BaseException) -> bool:
+    """Device-memory exhaustion, any backend's phrasing."""
+    msg = str(e)
+    return "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
+
+
+def dump_memory_profile(save_dir: str, tag: str = "oom") -> str | None:
+    """Write ``jax.profiler.device_memory_profile()`` (a pprof protobuf) to
+    ``save_dir/memory_{tag}_{ts}.prof`` — the MemorySnapshot/OOMObserver
+    analog (reference wires torch memory tooling with remote upload,
+    ``photon/clients/trainer_utils.py:721-729``). Round 2 of this build was
+    blind on exactly an OOM; this leaves the allocation picture on disk.
+    Best-effort: returns the path or None."""
+    import pathlib
+    import time as _time
+
+    try:
+        import jax
+
+        data = jax.profiler.device_memory_profile()
+        out = pathlib.Path(save_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        path = out / f"memory_{tag}_{_time.strftime('%Y%m%dT%H%M%SZ', _time.gmtime())}.prof"
+        path.write_bytes(data)
+        return str(path)
+    except Exception:  # noqa: BLE001 — diagnostics must never mask the OOM
+        return None
+
+
 @contextlib.contextmanager
 def trace(log_dir: str, enabled: bool = True) -> Iterator[None]:
     """jax.profiler trace context (reference: Composer Profiler,

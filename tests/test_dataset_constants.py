@@ -1,0 +1,89 @@
+"""mC4 constants registry + stream-remap knob (VERDICT r3 missing #2/#5)."""
+
+import numpy as np
+import pytest
+
+from photon_tpu.data.constants import (
+    DATASETS_CONSTANTS,
+    MC4_LANGUAGES,
+    resolve_split,
+)
+
+
+def test_registry_covers_reference_languages():
+    # photon/dataset/constants/mc4.py pins exactly these 13 languages
+    assert set(MC4_LANGUAGES) == {
+        "en", "sr", "la", "sw", "ur", "ms", "zh", "it", "es", "de", "el", "ru", "hi"
+    }
+    assert set(DATASETS_CONSTANTS) == {f"c4_{l}" for l in MC4_LANGUAGES}
+
+
+def test_english_truncated_splits_match_reference():
+    en = DATASETS_CONSTANTS["c4_en"]
+    assert en.splits["train_small"].truncated_samples == 100_000
+    assert en.splits["val_small"].truncated_samples == 10_000
+    assert en.splits["val_xsmall"].truncated_samples == 3_000
+    assert en.splits["val_xxsmall"].truncated_samples == 100
+    assert en.splits["train"].truncated_samples is None
+    # folder_split maps HF "validation" -> local "val" dirs
+    assert en.splits["validation"].folder_split == "val"
+
+
+def test_non_english_languages_have_full_splits_only():
+    for lang in MC4_LANGUAGES:
+        if lang == "en":
+            continue
+        consts = DATASETS_CONSTANTS[f"c4_{lang}"]
+        assert set(consts.splits) == {"train", "validation"}
+        for sp in consts:
+            assert sp.truncated_samples is None
+            assert sp.name == lang
+            assert sp.path == "allenai/c4"
+
+
+def test_resolve_split_errors_are_actionable():
+    with pytest.raises(KeyError, match="unknown dataset key"):
+        resolve_split("c4_xx", "train")
+    with pytest.raises(KeyError, match="no split"):
+        resolve_split("c4_sr", "train_small")
+
+
+def test_stream_remap_modulo(tmp_path):
+    """n_streams=2 maps cid 5 onto client_1's stream (streams[cid % n],
+    reference llm_config_functions.py:388-436)."""
+    from photon_tpu.config.schema import Config
+    from photon_tpu.data import make_synthetic_dataset
+    from photon_tpu.federation.client_runtime import ClientRuntime
+    from photon_tpu.federation.transport import ParamTransport
+
+    for i in range(2):
+        make_synthetic_dataset(
+            str(tmp_path / f"client_{i}" / "train"),
+            n_samples=8, seq_len=16, vocab_size=64, seed=i,
+        )
+    cfg = Config()
+    cfg.model.d_model = 16
+    cfg.model.n_layers = 1
+    cfg.model.n_heads = 2
+    cfg.model.max_seq_len = 16
+    cfg.model.vocab_size = 64
+    cfg.model.attn_impl = "xla"
+    cfg.model.compute_dtype = "float32"
+    cfg.train.global_batch_size = 4
+    cfg.train.device_microbatch_size = 4
+    cfg.dataset.local_path = str(tmp_path)
+    cfg.dataset.synthetic = False
+    cfg.dataset.n_streams = 2
+    cfg.photon.save_path = str(tmp_path / "run")
+    cfg.validate()
+
+    rt = ClientRuntime(cfg, ParamTransport("inline"))
+    loader_5 = rt._loader(5, "train", batch_size=4)   # 5 % 2 == 1
+    loader_1 = rt._loader(1, "train", batch_size=4)
+    b5, b1 = next(iter(loader_5)), next(iter(loader_1))
+    assert b5.shape == b1.shape == (4, 16)
+    # same underlying stream: both loaders read client_1's dataset
+    ds5 = rt._loaders[(5, "train")].ds
+    ds1 = rt._loaders[(1, "train")].ds
+    assert ds5.path == ds1.path
+    assert ds5.path.parts[-2] == "client_1"
