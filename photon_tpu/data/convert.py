@@ -139,7 +139,9 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--out", required=True)
     ap.add_argument("--n-clients", type=int, default=8)
     ap.add_argument("--seq-len", type=int, default=2048)
-    ap.add_argument("--split", default="train")
+    ap.add_argument("--split", default=None,
+                    help="output folder split name (default: the registry's "
+                         "folder_split with --dataset-key, else 'train')")
     ap.add_argument("--max-samples", type=int, default=None)
     ap.add_argument("--samples-per-shard", type=int, default=4096)
     args = ap.parse_args(argv)
@@ -156,11 +158,14 @@ def main(argv: list[str] | None = None) -> None:
             import itertools
 
             docs = itertools.islice(docs, consts.truncated_samples)
-        args.split = consts.folder_split if args.split == "train" else args.split
+        if args.split is None:  # explicit --split always wins
+            args.split = consts.folder_split
     elif args.hf_dataset:
         docs = iter_hf_dataset(args.hf_dataset, args.hf_config, args.hf_split)
     else:
         docs = iter_text_files(args.text_files)
+    if args.split is None:
+        args.split = "train"
     summary = convert_corpus(
         docs,
         args.out,

@@ -99,7 +99,7 @@ def _prime_gsm8k_shots() -> None:
 
     train = datasets.load_dataset("openai/gsm8k", "main", split="train")
     del _GSM8K_SHOTS[:]
-    for row in list(train)[:8]:
+    for row in train.select(range(8)):
         cot, _, final = row["answer"].partition("####")
         _GSM8K_SHOTS.append(
             f"Question: {row['question']}\n\nA:{cot.strip()}\n"

@@ -33,19 +33,43 @@ from photon_tpu.federation import (
 from photon_tpu.metrics.history import make_wandb_run
 
 
-def build_app(cfg: Config, n_nodes: int = 1, multiprocess: bool = False) -> ServerApp:
+def build_app(
+    cfg: Config,
+    n_nodes: int = 1,
+    multiprocess: bool = False,
+    tcp_listen: str | None = None,
+) -> ServerApp:
     save = pathlib.Path(cfg.photon.save_path)
     save.mkdir(parents=True, exist_ok=True)
-    cfg.to_yaml(save / "config.yaml")  # the resolved config of record
 
     store = FileStore(save / "store")
-    mode = "objstore" if (multiprocess or cfg.photon.comm_stack.objstore) else (
+    mode = "objstore" if (multiprocess or tcp_listen or cfg.photon.comm_stack.objstore) else (
         "shm" if cfg.photon.comm_stack.shm else "inline"
     )
-
-    if multiprocess:
+    if mode == "objstore":
+        # normalize BEFORE dumping the config of record: every other process
+        # (multiprocess children, TCP node agents) re-loads it and must agree
+        # on the bulk-tensor plane (reference: resolved config.yaml is the
+        # IPC of record, ``hydra_resolver.py:30-39``)
         cfg.photon.comm_stack.objstore = True
         cfg.photon.comm_stack.shm = False
+    cfg.to_yaml(save / "config.yaml")
+
+    if tcp_listen:
+        # multi-host: node agents dial in from other machines/processes
+        # (reference: superlink + remote DRIVER_API_ADDRESS,
+        # ``scripts/fed_125m_example.sh:104-137``); bulk tensors ride the
+        # shared objstore, control messages the sockets
+        from photon_tpu.federation.tcp import TcpServerDriver
+
+        host, _, port = tcp_listen.rpartition(":")
+        driver = TcpServerDriver(host or "0.0.0.0", int(port), expected_nodes=n_nodes)
+        print(f"[federated] listening on {host or '0.0.0.0'}:{driver.port}, "
+              f"waiting for {n_nodes} node(s)", flush=True)
+        # node hosts may take a while to provision; reuse the fit timeout
+        # knob rather than hardcoding a second, unconfigurable limit
+        driver.wait_for_nodes(timeout=cfg.fl.fit_timeout_s)
+    elif multiprocess:
         driver = MultiprocessDriver(cfg, n_nodes=n_nodes)
     else:
         def make_agent(node_id: str) -> NodeAgent:
@@ -81,6 +105,9 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--rounds", type=int, default=None)
     ap.add_argument("--nodes", type=int, default=1)
     ap.add_argument("--multiprocess", action="store_true")
+    ap.add_argument("--tcp-listen", default=None, metavar="HOST:PORT",
+                    help="serve the round loop over TCP; node agents join "
+                         "via `python -m photon_tpu.federation.tcp --connect`")
     # action="append": each --set adds one override (nargs="*" would make
     # every repeated --set silently REPLACE the previous list)
     ap.add_argument("--set", action="append", default=[], metavar="KEY=VALUE")
@@ -99,7 +126,10 @@ def main(argv: list[str] | None = None) -> None:
         _apply_override(cfg, key, value)
     cfg.validate()
 
-    app = build_app(cfg, n_nodes=args.nodes, multiprocess=args.multiprocess)
+    app = build_app(
+        cfg, n_nodes=args.nodes, multiprocess=args.multiprocess,
+        tcp_listen=args.tcp_listen,
+    )
     try:
         history = app.run(args.rounds)
     finally:

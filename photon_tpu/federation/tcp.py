@@ -264,7 +264,17 @@ def run_node(server_addr: str, node_id: str, cfg_json: str, retries: int = 30) -
         mode = "objstore" if cfg.photon.comm_stack.objstore else "shm"
         return ParamTransport(mode, store=store)
 
-    agent = NodeAgent(cfg, node_id, make_transport)
+    make_ckpt_mgr = None
+    if store is not None and cfg.photon.checkpoint:
+        # client checkpoints (skip-if-done / mid-round resume) need the
+        # same store the server GCs (reference: client Composer ckpts in
+        # the shared save_folder, ``llm_config_functions.py:642-764``)
+        from photon_tpu.checkpoint import ClientCheckpointManager
+
+        def make_ckpt_mgr():
+            return ClientCheckpointManager(store, cfg.run_uuid)
+
+    agent = NodeAgent(cfg, node_id, make_transport, make_ckpt_mgr=make_ckpt_mgr)
     for attempt in range(retries):
         try:
             sock = socket.create_connection((host, int(port)), timeout=10)

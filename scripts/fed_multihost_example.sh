@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Multi-host federated run over the TCP control plane + shared objstore
+# (reference: scripts/fed_125m_example.sh:104-137 — superlink on one host,
+# client-app processes pointed at DRIVER_API_ADDRESS).
+#
+# Server host:
+#   ROLE=server SAVE_PATH=/shared/run ./scripts/fed_multihost_example.sh
+# Each node host (after the server prints "listening"):
+#   ROLE=node NODE_ID=node0 SERVER=10.0.0.1:9777 SAVE_PATH=/shared/run \
+#       ./scripts/fed_multihost_example.sh
+#
+# SAVE_PATH must be shared storage (NFS/GCS-fuse): bulk tensors travel as
+# objstore pointers, only control messages ride the sockets. For slices in
+# one jax.distributed job, prefer the collective aggregation path
+# (photon_tpu/parallel/collective_agg.py) over the objstore.
+set -euo pipefail
+
+ROLE=${ROLE:-server}
+SAVE_PATH=${SAVE_PATH:-/tmp/photon_tpu_multihost}
+LISTEN=${LISTEN:-0.0.0.0:9777}
+SERVER=${SERVER:-127.0.0.1:9777}
+NODE_ID=${NODE_ID:-node0}
+N_NODES=${N_NODES:-2}
+ROUNDS=${ROUNDS:-320}
+
+if [[ "$ROLE" == "server" ]]; then
+  exec python -m photon_tpu.federated \
+    --preset mpt-125m \
+    --rounds "$ROUNDS" \
+    --nodes "$N_NODES" \
+    --tcp-listen "$LISTEN" \
+    --set fl.n_total_clients=8 \
+    --set fl.n_clients_per_round=8 \
+    --set fl.local_steps=128 \
+    --set fl.strategy_name=nesterov \
+    --set fl.server_learning_rate=1.0 \
+    --set fl.server_momentum=0.0 \
+    --set train.global_batch_size=32 \
+    --set photon.checkpoint=true \
+    --set photon.save_path="$SAVE_PATH"
+else
+  # the server dumps the resolved config of record at startup
+  CONFIG="$SAVE_PATH/config.yaml"
+  for _ in $(seq 60); do [[ -f "$CONFIG" ]] && break; sleep 2; done
+  exec python -m photon_tpu.federation.tcp \
+    --connect "$SERVER" --node-id "$NODE_ID" --config "$CONFIG"
+fi

@@ -1,6 +1,5 @@
 """mC4 constants registry + stream-remap knob (VERDICT r3 missing #2/#5)."""
 
-import numpy as np
 import pytest
 
 from photon_tpu.data.constants import (

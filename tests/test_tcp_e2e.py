@@ -1,0 +1,137 @@
+"""Cross-process end-to-end federation over real TCP sockets + objstore
+(VERDICT r3 #7): server CLI process + two node-agent processes + FileStore,
+running fit + eval + checkpoint, then a separate resumed run.
+
+This is the multi-node flow of the reference
+(``scripts/fed_125m_example.sh:104-137``: superlink on one host, client-app
+processes pointed at its address) driven through
+``python -m photon_tpu.federated --tcp-listen`` and
+``python -m photon_tpu.federation.tcp --connect``."""
+
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from photon_tpu.config.schema import Config
+
+REPO = pathlib.Path(__file__).parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env() -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + (
+        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _cfg(tmp_path) -> Config:
+    cfg = Config()
+    cfg.model.d_model = 32
+    cfg.model.n_layers = 2
+    cfg.model.n_heads = 2
+    cfg.model.max_seq_len = 16
+    cfg.model.vocab_size = 64
+    cfg.model.attn_impl = "xla"
+    cfg.model.compute_dtype = "float32"
+    cfg.train.global_batch_size = 4
+    cfg.train.device_microbatch_size = 4
+    cfg.train.eval_batches = 2
+    cfg.fl.n_total_clients = 2
+    cfg.fl.n_clients_per_round = 2
+    cfg.fl.n_rounds = 2
+    cfg.fl.local_steps = 2
+    cfg.fl.eval_interval_rounds = 2
+    cfg.dataset.synthetic = True
+    cfg.photon.save_path = str(tmp_path / "run")
+    cfg.photon.checkpoint = True
+    # node agents load this YAML directly: the bulk plane must be declared
+    # (the server CLI normalizes its own copy the same way)
+    cfg.photon.comm_stack.objstore = True
+    cfg.photon.comm_stack.shm = False
+    cfg.run_uuid = "tcp-e2e"
+    cfg.validate()
+    return cfg
+
+
+def _spawn_nodes(cfg_path: str, port: int, n: int) -> list[subprocess.Popen]:
+    return [
+        subprocess.Popen(
+            [sys.executable, "-m", "photon_tpu.federation.tcp",
+             "--connect", f"127.0.0.1:{port}",
+             "--node-id", f"node{i}", "--config", cfg_path],
+            env=_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(n)
+    ]
+
+
+def _run_server(cfg_path: str, port: int, extra: list[str]) -> dict:
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_tpu.federated",
+         "--config", cfg_path, "--tcp-listen", f"127.0.0.1:{port}",
+         "--nodes", "2", *extra],
+        env=_env(), capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    last = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    return json.loads(last)
+
+
+@pytest.mark.slow
+def test_tcp_two_process_fit_eval_checkpoint_resume(tmp_path):
+    cfg = _cfg(tmp_path)
+    cfg_path = str(tmp_path / "run.yaml")
+    cfg.to_yaml(cfg_path)
+
+    # --- run 1: 2 rounds of fit + eval, checkpoints to the FileStore -----
+    port = _free_port()
+    nodes = _spawn_nodes(cfg_path, port, 2)
+    try:
+        out = _run_server(cfg_path, port, extra=[])
+        assert out["server/round_time"] > 0
+        assert out["server/eval_loss"] > 0  # eval ran at round 2
+    finally:
+        for p in nodes:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    store_root = pathlib.Path(cfg.photon.save_path) / "store"
+    rounds = sorted((store_root / "tcp-e2e" / "server").glob("*"))
+    assert rounds, f"no server round checkpoints under {store_root}"
+
+    # --- run 2: resume from the latest round over fresh processes --------
+    port2 = _free_port()
+    nodes2 = _spawn_nodes(cfg_path, port2, 2)
+    try:
+        out2 = _run_server(
+            cfg_path, port2,
+            extra=["--rounds", "3", "--set", "photon.resume_round=-1"],
+        )
+        assert out2["server/round_time"] > 0
+    finally:
+        for p in nodes2:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    # round 3 checkpoint exists after the resumed run
+    rounds_after = sorted((store_root / "tcp-e2e" / "server").glob("*"))
+    assert len(rounds_after) >= len(rounds)
+    assert any(r.name == "3" for r in rounds_after), [r.name for r in rounds_after]
