@@ -24,6 +24,12 @@ On TPU the run also executes a Pallas-vs-XLA kernel parity check — the 125M
 attention shape plus the 1B shape (d_head 128), a non-causal case, and a
 lane-padded d_head — and writes KERNEL_PARITY.json (with platform/device
 provenance) next to this file; `kernel_parity_ok` lands in the JSON line.
+Parity runs AFTER the throughput number is emitted, and the supervisor
+watches child stderr with an inactivity watchdog
+(PHOTON_BENCH_IDLE_TIMEOUT, default 420 s): a relay stall mid-compile is
+killed fast, salvaging any already-emitted result, instead of burning the
+whole hard-timeout window (round-4 postmortem: a wedged relay froze the
+child inside parity compile #5 with zero output for 25 minutes).
 MFU is reported against the detected chip's bf16 peak (utils/profiling.py).
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
@@ -144,11 +150,91 @@ def _classify(stderr: str, timed_out: bool) -> str:
     return "error"
 
 
+class _Child:
+    """Run the bench child streaming stderr, with BOTH a hard timeout and an
+    inactivity watchdog.
+
+    Round-4 postmortem: a wedged axon relay freezes the child mid-compile
+    with zero output; a flat ``subprocess.run(timeout=1500)`` then burns the
+    whole window discovering nothing. The child logs a heartbeat line before
+    every compile, so >``idle_timeout`` seconds of stderr silence means it is
+    stuck in one relay RPC — kill it early and classify the failure as a
+    stall instead of waiting out the hard timeout.
+    """
+
+    def __init__(self, cmd, env, hard_timeout: int, idle_timeout: int):
+        import threading
+
+        self.proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=str(HERE), env=env,
+        )
+        self.stdout_lines: list[str] = []
+        self.stderr_lines: list[str] = []
+        self.last_activity = time.monotonic()
+        self.hard_timeout = hard_timeout
+        self.idle_timeout = idle_timeout
+        self._threads = [
+            threading.Thread(target=self._pump, args=(self.proc.stdout, self.stdout_lines),
+                             daemon=True),
+            threading.Thread(target=self._pump, args=(self.proc.stderr, self.stderr_lines),
+                             daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _pump(self, pipe, sink):
+        for line in pipe:
+            sink.append(line.rstrip("\n"))
+            if sink is self.stderr_lines:
+                log(f"  {line.rstrip()}")
+            self.last_activity = time.monotonic()
+
+    def wait(self) -> tuple[int | None, bool]:
+        """Returns (rc, timed_out). rc None when killed by a watchdog."""
+        t0 = time.monotonic()
+        while True:
+            rc = self.proc.poll()
+            if rc is not None:
+                for t in self._threads:
+                    t.join(timeout=5)
+                return rc, False
+            now = time.monotonic()
+            if now - t0 > self.hard_timeout:
+                log(f"hard timeout ({self.hard_timeout}s) — killing child")
+                return self._kill()
+            if now - self.last_activity > self.idle_timeout:
+                log(f"no output for {self.idle_timeout}s — killing stalled child")
+                return self._kill()
+            time.sleep(2)
+
+    def _kill(self) -> tuple[None, bool]:
+        self.proc.kill()
+        self.proc.wait()
+        # join the pump threads so the salvage scan doesn't race a
+        # still-draining pipe (the emitted result line may be in flight)
+        for t in self._threads:
+            t.join(timeout=10)
+        return None, True
+
+    @property
+    def stdout(self) -> str:
+        return "\n".join(self.stdout_lines)
+
+    @property
+    def stderr(self) -> str:
+        return "\n".join(self.stderr_lines)
+
+
 def supervise() -> int:
     attempts = _attempts(os.environ.get("PHOTON_BENCH_PLATFORM", ""))
     attempts_log: list[dict] = []
     last_tail = ""
     oom_seen = False
+    # generous enough for one legitimately slow cold compile between
+    # heartbeat lines (~20-120s observed); a relay wedge shows unbounded
+    # silence, so 420s still fails ~4x faster than the hard timeout
+    idle_timeout = int(os.environ.get("PHOTON_BENCH_IDLE_TIMEOUT", "420"))
     i = 0
     prev_key = None
     while i < len(attempts):
@@ -165,68 +251,68 @@ def supervise() -> int:
             env.pop("PHOTON_BENCH_MICROBATCH", None)
             log(f"previous attempt OOMed: retrying with reduced config {_OOM_ENV}")
         cmd = [sys.executable, str(pathlib.Path(__file__).resolve()), "--run", "--platform", platform]
-        log(f"spawning: {' '.join(cmd[1:])} (timeout {tmo}s, env={extra_env})")
+        log(f"spawning: {' '.join(cmd[1:])} (timeout {tmo}s, idle {idle_timeout}s, env={extra_env})")
         t_attempt = time.monotonic()
-        try:
-            proc = subprocess.run(
-                cmd, capture_output=True, text=True, timeout=tmo, cwd=str(HERE), env=env
-            )
-        except subprocess.TimeoutExpired as e:
-            def _text(x):
-                return x.decode(errors="replace") if isinstance(x, bytes) else (x or "")
-
-            # the child may have emitted a valid result and then hung in
-            # teardown (the documented relay failure mode) — salvage it
-            salvaged = _scan_result(_text(e.stdout))
-            if salvaged is not None:
-                log(f"attempt {i + 1} ({platform}): child hung in teardown after "
-                    "emitting a valid result — using it")
+        child = _Child(cmd, env, hard_timeout=tmo, idle_timeout=idle_timeout)
+        rc, timed_out = child.wait()
+        result = _scan_result(child.stdout)
+        if timed_out:
+            # the child may have emitted a valid result and then stalled in
+            # the post-emit parity suite or teardown (the documented relay
+            # failure mode) — salvage it
+            if result is not None:
+                log(f"attempt {i + 1} ({platform}): child stalled after emitting "
+                    "a valid result — using it")
                 attempts_log.append({
-                    "platform": platform, "rc": None, "outcome": "ok-teardown-hang",
+                    "platform": platform, "rc": None, "outcome": "ok-stall-after-emit",
                     "seconds": round(time.monotonic() - t_attempt, 1),
                 })
-                salvaged["attempts"] = attempts_log
-                emit(salvaged)
+                result["attempts"] = attempts_log
+                emit(result)
                 return 0
-            stderr_tail = " | ".join(_text(e.stderr).strip().splitlines()[-5:])
-            last_tail = f"attempt {i + 1} ({platform}): timed out after {tmo}s; {stderr_tail}"
+            stderr_tail = " | ".join(child.stderr.strip().splitlines()[-5:])
+            last_tail = f"attempt {i + 1} ({platform}): stalled/timed out; {stderr_tail}"
             log(last_tail)
             attempts_log.append({
                 "platform": platform, "rc": None,
-                "outcome": _classify(_text(e.stderr), timed_out=True),
+                "outcome": _classify(child.stderr, timed_out=True),
                 "seconds": round(time.monotonic() - t_attempt, 1),
                 "stderr_tail": stderr_tail[-400:],
             })
-            if platform == "tpu":
-                # a SIGKILLed TPU client mid-claim wedges the relay, so
-                # further TPU attempts would hang their full timeout too —
-                # skip straight to the CPU fallback
-                log("TPU attempt hung; skipping remaining TPU attempts (relay likely wedged)")
+            # A SIGKILLed TPU client mid-claim can wedge the relay; but with
+            # the fail-fast idle watchdog there is window budget for ONE
+            # more TPU try (the claim often frees once the dead client's
+            # socket closes). A second stall skips to CPU.
+            n_tpu_stalls = sum(
+                1 for a in attempts_log if a["platform"] == "tpu" and a["rc"] is None
+            )
+            if platform == "tpu" and n_tpu_stalls >= 2:
+                log("two TPU stalls; skipping remaining TPU attempts (relay wedged)")
                 i = next((j for j, (p, _, _) in enumerate(attempts) if j > i and p != "tpu"),
                          len(attempts))
             else:
                 i += 1
             continue
-        for line in proc.stderr.splitlines():
-            log(f"  {line}")
-        result = _scan_result(proc.stdout)
-        if result is not None and proc.returncode == 0:
+        if result is not None:
+            # salvage even on rc != 0: the headline emit precedes the parity
+            # suite, so a parity crash must not discard a valid result
+            outcome = "ok" if rc == 0 else f"ok-then-rc{rc}"
             attempts_log.append({
-                "platform": platform, "rc": 0, "outcome": "ok",
+                "platform": platform, "rc": rc, "outcome": outcome,
                 "seconds": round(time.monotonic() - t_attempt, 1),
             })
             result["attempts"] = attempts_log
             emit(result)
             return 0
-        stderr = proc.stderr or ""
+        stderr = child.stderr
         oom_seen = "RESOURCE_EXHAUSTED" in stderr or "Out of memory" in stderr
         last_tail = (
-            f"attempt {i + 1} ({platform}): rc={proc.returncode}; "
+            f"attempt {i + 1} ({platform}): rc={rc}; "
             + " | ".join(stderr.strip().splitlines()[-3:])
         )
         log(last_tail)
         attempts_log.append({
-            "platform": platform, "rc": proc.returncode,
+            "platform": platform, "rc": rc,
             "outcome": _classify(stderr, timed_out=False),
             "seconds": round(time.monotonic() - t_attempt, 1),
             "stderr_tail": " | ".join(stderr.strip().splitlines()[-3:])[-400:],
@@ -270,7 +356,9 @@ def _parity_shape(b: int, s: int, h: int, d: int, causal: bool, alibi: bool = Fa
 
     res: dict = {"shape": {"batch": b, "seq": s, "heads": h, "d_head": d,
                            "causal": causal, "alibi": alibi, "dtype": "bfloat16"}}
+    log(f"parity b{b} s{s} h{h} d{d} causal={causal} alibi={alibi}: pallas fwd...")
     o_p = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=causal, alibi=alibi))(q, k, v)
+    log("  xla fwd...")
     o_x = jax.jit(lambda q, k, v: xla_attention(q, k, v, causal=causal, alibi=alibi))(q, k, v)
     res["fwd_rel_err"] = rel(o_p, o_x)
 
@@ -279,7 +367,9 @@ def _parity_shape(b: int, s: int, h: int, d: int, causal: bool, alibi: bool = Fa
             lambda q, k, v: (fn(q, k, v).astype(jnp.float32) * w).sum(), argnums=(0, 1, 2)
         ))
 
+    log("  pallas bwd...")
     gp = loss(lambda q, k, v: flash_attention(q, k, v, causal=causal, alibi=alibi))(q, k, v)
+    log("  xla bwd...")
     gx = loss(lambda q, k, v: xla_attention(q, k, v, causal=causal, alibi=alibi))(q, k, v)
     for name, a, ref in zip(("dq", "dk", "dv"), gp, gx):
         res[f"bwd_{name}_rel_err"] = rel(a, ref)
@@ -320,6 +410,7 @@ def kernel_parity(full: bool = True) -> dict:
         ref = jnp.asarray(ref, jnp.float32)
         return float(jnp.linalg.norm(a - ref) / (jnp.linalg.norm(ref) + 1e-12))
 
+    log("parity lse ring inner path...")
     o_l, lse_l = jax.jit(
         lambda q, k, v: flash_attention_with_lse(q, k, v, causal=True, q_start=0, k_start=0)
     )(q, k, v)
@@ -406,13 +497,6 @@ def run(platform: str) -> None:
     on_tpu = dev.platform == "tpu"
     if platform == "tpu" and not on_tpu:
         raise RuntimeError(f"wanted tpu, got {dev.platform}")
-
-    parity = None
-    if on_tpu and os.environ.get("PHOTON_BENCH_SKIP_PARITY") != "1":
-        t0 = time.perf_counter()
-        parity = kernel_parity(full=True)
-        (HERE / "KERNEL_PARITY.json").write_text(json.dumps(parity, indent=2))
-        log(f"kernel parity in {time.perf_counter() - t0:.1f}s: ok={parity['ok']}")
 
     cfg = Config()
     cfg.model.attn_impl = "pallas" if on_tpu else "xla"
@@ -517,9 +601,26 @@ def run(platform: str) -> None:
     }
     if not on_tpu:
         out["degraded"] = "cpu-smoke-fallback (2-layer seq-256 model, not the 125M recipe)"
-    if parity is not None:
-        out["kernel_parity_ok"] = parity["ok"]
+    # emit the headline BEFORE the parity suite: a relay stall inside
+    # parity's ~26 compiles must not cost the round its throughput number
+    # (the supervisor salvages the last emitted metric line on stall; a
+    # second emit below upgrades it with kernel_parity_ok)
     emit(out)
+    if on_tpu and os.environ.get("PHOTON_BENCH_SKIP_PARITY") != "1":
+        # free the trainer's HBM first — parity allocates its own test tensors
+        trainer.state = None
+        t0 = time.perf_counter()
+        try:
+            parity = kernel_parity(full=True)
+        except Exception as e:  # noqa: BLE001 — parity must not sink the result
+            log(f"kernel parity CRASHED: {type(e).__name__}: {e}")
+            out["kernel_parity_ok"] = False
+            out["kernel_parity_error"] = f"{type(e).__name__}: {e}"[:300]
+        else:
+            (HERE / "KERNEL_PARITY.json").write_text(json.dumps(parity, indent=2))
+            log(f"kernel parity in {time.perf_counter() - t0:.1f}s: ok={parity['ok']}")
+            out["kernel_parity_ok"] = parity["ok"]
+        emit(out)
 
 
 def main() -> int:

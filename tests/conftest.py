@@ -40,6 +40,33 @@ def pytest_configure(config):
             pass  # no toolchain: numpy fallbacks keep the suite green
 
 
+def free_port() -> int:
+    """Bind-port-0 trick for subprocess tests (TCP driver, jax.distributed)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def subprocess_env() -> dict:
+    """Env for spawned children: repo APPENDED to PYTHONPATH (never replace —
+    /root/.axon_site must stay importable), TPU plugin registration skipped
+    (PALLAS_AXON_POOL_IPS="" — a second relay claimant wedges the chip), CPU
+    backend forced."""
+    import os
+    import pathlib
+
+    env = dict(os.environ)
+    repo = str(pathlib.Path(__file__).parent.parent)
+    env["PYTHONPATH"] = repo + (
+        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
 @pytest.fixture(scope="module")
 def tiny_trainer():
     """A single-device Trainer on a tiny model + one synthetic batch."""

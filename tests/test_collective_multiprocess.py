@@ -8,8 +8,6 @@ intra-process shared memory. Process 0 checks parity against the host
 streaming-average oracle (``aggregate_inplace``)."""
 
 import json
-import pathlib
-import socket
 import subprocess
 import sys
 
@@ -73,39 +71,35 @@ print(f"proc {pid} done", flush=True)
 
 @pytest.mark.slow
 def test_collective_average_across_two_processes(tmp_path):
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    from tests.conftest import free_port, subprocess_env
 
+    port = free_port()
     script = tmp_path / "child.py"
     script.write_text(CHILD)
     outs = [tmp_path / f"out_{pid}.json" for pid in range(2)]
-    import os
+    logs = [tmp_path / f"child_{pid}.log" for pid in range(2)]
 
-    # APPEND the repo to PYTHONPATH (never replace: /root/.axon_site must
-    # stay importable per the project verify notes); empty POOL_IPS skips
-    # TPU plugin registration in the children
-    repo = str(pathlib.Path(__file__).parent.parent)
-    env = dict(os.environ)
-    env["PYTHONPATH"] = repo + (
-        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
-    )
-    env["PALLAS_AXON_POOL_IPS"] = ""
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(pid), str(port), str(outs[pid])],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        )
-        for pid in range(2)
-    ]
-    for p in procs:
+    # child output goes to files, not PIPEs: proc 1's pipe is undrained
+    # while proc 0 is being waited on — distributed-logging chatter past the
+    # pipe buffer would deadlock the collective mid-psum
+    procs = []
+    for pid in range(2):
+        with logs[pid].open("w") as logf:
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, str(script), str(pid), str(port), str(outs[pid])],
+                    env=subprocess_env(), stdout=logf, stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+    for pid, p in enumerate(procs):
         try:
-            _, err = p.communicate(timeout=240)
+            p.wait(timeout=240)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             pytest.fail("multiprocess collective aggregation timed out")
-        assert p.returncode == 0, err[-2000:]
+        assert p.returncode == 0, logs[pid].read_text()[-2000:]
 
     from photon_tpu.strategy.aggregation import aggregate_inplace
 

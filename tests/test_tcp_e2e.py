@@ -9,9 +9,7 @@ processes pointed at its address) driven through
 ``python -m photon_tpu.federation.tcp --connect``."""
 
 import json
-import os
 import pathlib
-import socket
 import subprocess
 import sys
 
@@ -19,23 +17,8 @@ import pytest
 
 from photon_tpu.config.schema import Config
 
-REPO = pathlib.Path(__file__).parent.parent
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _env() -> dict:
-    env = dict(os.environ)
-    env["PYTHONPATH"] = str(REPO) + (
-        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
-    )
-    env["PALLAS_AXON_POOL_IPS"] = ""
-    env["JAX_PLATFORMS"] = "cpu"
-    return env
+from tests.conftest import free_port as _free_port
+from tests.conftest import subprocess_env as _env
 
 
 def _cfg(tmp_path) -> Config:
@@ -67,16 +50,25 @@ def _cfg(tmp_path) -> Config:
     return cfg
 
 
-def _spawn_nodes(cfg_path: str, port: int, n: int) -> list[subprocess.Popen]:
-    return [
-        subprocess.Popen(
-            [sys.executable, "-m", "photon_tpu.federation.tcp",
-             "--connect", f"127.0.0.1:{port}",
-             "--node-id", f"node{i}", "--config", cfg_path],
-            env=_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        )
-        for i in range(n)
-    ]
+def _spawn_nodes(
+    cfg_path: str, port: int, n: int, log_dir: pathlib.Path, run: str
+) -> list[subprocess.Popen]:
+    # node output goes to files, not PIPEs: nobody drains a PIPE until
+    # wait(), so a chatty node would block on a full pipe buffer mid-round
+    # and wedge the whole federation; per-run filenames keep run-1 logs
+    # intact as diagnostics when the resume run fails
+    procs = []
+    for i in range(n):
+        with (log_dir / f"{run}_node{i}.log").open("w") as out:
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "photon_tpu.federation.tcp",
+                     "--connect", f"127.0.0.1:{port}",
+                     "--node-id", f"node{i}", "--config", cfg_path],
+                    env=_env(), stdout=out, stderr=subprocess.STDOUT, text=True,
+                )
+            )
+    return procs
 
 
 def _run_server(cfg_path: str, port: int, extra: list[str]) -> dict:
@@ -99,7 +91,7 @@ def test_tcp_two_process_fit_eval_checkpoint_resume(tmp_path):
 
     # --- run 1: 2 rounds of fit + eval, checkpoints to the FileStore -----
     port = _free_port()
-    nodes = _spawn_nodes(cfg_path, port, 2)
+    nodes = _spawn_nodes(cfg_path, port, 2, tmp_path, "run1")
     try:
         out = _run_server(cfg_path, port, extra=[])
         assert out["server/round_time"] > 0
@@ -117,7 +109,7 @@ def test_tcp_two_process_fit_eval_checkpoint_resume(tmp_path):
 
     # --- run 2: resume from the latest round over fresh processes --------
     port2 = _free_port()
-    nodes2 = _spawn_nodes(cfg_path, port2, 2)
+    nodes2 = _spawn_nodes(cfg_path, port2, 2, tmp_path, "run2")
     try:
         out2 = _run_server(
             cfg_path, port2,
