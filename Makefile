@@ -1,12 +1,17 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test bench native clean
+.PHONY: test test-all bench native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
 	-$(MAKE) native
 	PALLAS_AXON_POOL_IPS= python -m pytest tests/ -x -q
+
+# the FULL pyramid including `slow` (multiprocess e2e, TCP, jax.distributed)
+test-all:
+	-$(MAKE) native
+	PALLAS_AXON_POOL_IPS= python -m pytest tests/ -x -q -m "slow or not slow"
 
 bench:
 	-$(MAKE) native

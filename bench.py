@@ -226,6 +226,17 @@ class _Child:
         return "\n".join(self.stderr_lines)
 
 
+def _stamp_parity_death(result: dict, platform: str, why: str) -> None:
+    """A TPU child that died/stalled AFTER the headline emit but BEFORE the
+    parity re-emit must not look like parity was merely skipped — stamp an
+    explicit failure so the JSON distinguishes 'not run' from 'died mid-run'."""
+    if os.environ.get("PHOTON_BENCH_SKIP_PARITY") == "1":
+        return  # parity legitimately not attempted
+    if platform == "tpu" and "kernel_parity_ok" not in result:
+        result["kernel_parity_ok"] = False
+        result["kernel_parity_error"] = why
+
+
 def supervise() -> int:
     attempts = _attempts(os.environ.get("PHOTON_BENCH_PLATFORM", ""))
     attempts_log: list[dict] = []
@@ -268,6 +279,7 @@ def supervise() -> int:
                     "seconds": round(time.monotonic() - t_attempt, 1),
                 })
                 result["attempts"] = attempts_log
+                _stamp_parity_death(result, platform, "child stalled during parity suite")
                 emit(result)
                 return 0
             stderr_tail = " | ".join(child.stderr.strip().splitlines()[-5:])
@@ -302,6 +314,8 @@ def supervise() -> int:
                 "seconds": round(time.monotonic() - t_attempt, 1),
             })
             result["attempts"] = attempts_log
+            if rc != 0:
+                _stamp_parity_death(result, platform, f"child died rc={rc} during parity suite")
             emit(result)
             return 0
         stderr = child.stderr

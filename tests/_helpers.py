@@ -1,0 +1,41 @@
+"""Shared helpers for subprocess-spawning tests.
+
+A plain module (NOT conftest) so test files can import it without
+re-executing conftest's module-level jax.config setup under a second module
+name (`tests.conftest` vs pytest's top-level `conftest`).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import socket
+
+# honor a user-set cache dir; default to the suite's persistent cache
+TEST_JAX_CACHE = os.environ.get("JAX_COMPILATION_CACHE_DIR") or str(
+    pathlib.Path(__file__).parent / ".jax_cache"
+)
+
+
+def free_port() -> int:
+    """Bind-port-0 trick for subprocess tests (TCP driver, jax.distributed)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def subprocess_env() -> dict:
+    """Env for spawned children: repo APPENDED to PYTHONPATH (never replace —
+    /root/.axon_site must stay importable), TPU plugin registration skipped
+    (PALLAS_AXON_POOL_IPS="" — a second relay claimant wedges the chip), CPU
+    backend forced, suite compile cache shared."""
+    env = dict(os.environ)
+    repo = str(pathlib.Path(__file__).parent.parent)
+    env["PYTHONPATH"] = repo + (
+        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_COMPILATION_CACHE_DIR"] = TEST_JAX_CACHE
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.5"
+    return env

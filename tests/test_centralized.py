@@ -1,5 +1,6 @@
 """Centralized trainer entry: smoke run on synthetic data + resume."""
 
+import pytest
 import numpy as np
 
 from photon_tpu.config.schema import (
@@ -27,6 +28,7 @@ def _cfg(tmp_path) -> Config:
     return cfg.validate()
 
 
+@pytest.mark.slow
 def test_centralized_smoke_and_resume(tmp_path, capsys):
     cfg = _cfg(tmp_path)
     h1 = run_centralized(cfg, total_steps=4, eval_first=True, dump_params=True)

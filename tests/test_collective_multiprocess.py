@@ -71,7 +71,7 @@ print(f"proc {pid} done", flush=True)
 
 @pytest.mark.slow
 def test_collective_average_across_two_processes(tmp_path):
-    from tests.conftest import free_port, subprocess_env
+    from tests._helpers import free_port, subprocess_env
 
     port = free_port()
     script = tmp_path / "child.py"

@@ -80,6 +80,7 @@ def test_fed_rounds_end_to_end(tmp_path):
     app.driver.shutdown()
 
 
+@pytest.mark.slow
 def test_training_actually_changes_params(tmp_path):
     cfg = make_cfg(tmp_path, n_rounds=2)
     app = make_app(cfg, tmp_path)
@@ -90,6 +91,7 @@ def test_training_actually_changes_params(tmp_path):
     app.driver.shutdown()
 
 
+@pytest.mark.slow
 def test_sampling_deterministic(tmp_path):
     cfg = make_cfg(tmp_path)
     a = make_app(cfg, tmp_path)
@@ -101,6 +103,7 @@ def test_sampling_deterministic(tmp_path):
     a.driver.shutdown(); b.driver.shutdown()
 
 
+@pytest.mark.slow
 def test_failure_budget(tmp_path):
     cfg = make_cfg(tmp_path, accept_failures_cnt=0)
     app = make_app(cfg, tmp_path)
@@ -133,6 +136,7 @@ def test_failure_budget(tmp_path):
     app.driver.shutdown()
 
 
+@pytest.mark.slow
 def test_failed_cid_retries_once_then_counts(tmp_path):
     """A cid that fails once but succeeds on retry must not raise."""
     cfg = make_cfg(tmp_path, accept_failures_cnt=0, n_clients_per_round=2)
@@ -156,6 +160,7 @@ def test_failed_cid_retries_once_then_counts(tmp_path):
     app.driver.shutdown()
 
 
+@pytest.mark.slow
 def test_eval_round(tmp_path):
     cfg = make_cfg(tmp_path, eval_interval_rounds=1, n_rounds=1)
     app = make_app(cfg, tmp_path)
@@ -165,6 +170,7 @@ def test_eval_round(tmp_path):
     app.driver.shutdown()
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_matches_uninterrupted(tmp_path):
     """Golden determinism oracle: run 4 rounds straight vs 2 + resume + 2.
     Parameters and the sampled-client sequence must match exactly.
@@ -204,6 +210,7 @@ def test_checkpoint_resume_matches_uninterrupted(tmp_path):
         np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_refresh_period_broadcast(tmp_path):
     cfg = make_cfg(tmp_path, n_rounds=3)
     cfg.photon.refresh_period = 2

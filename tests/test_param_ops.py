@@ -168,6 +168,7 @@ def test_momenta_with_frozen_params():
     np.testing.assert_allclose(got_m1[trainable[0]], m1[trainable[0]], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_fed_round_with_momenta_aggregation(tmp_path):
     from tests.test_federation import make_cfg, make_app
 

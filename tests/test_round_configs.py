@@ -121,6 +121,7 @@ def test_server_rejects_bad_eval_config(tmp_path):
         ServerApp(cfg, NullDriver(), ParamTransport("inline"))
 
 
+@pytest.mark.slow
 def test_eval_config_reaches_clients(tmp_path):
     """eval_config set in FLConfig must arrive in EvaluateIns.config."""
     from photon_tpu.federation.messages import EvaluateIns

@@ -145,6 +145,7 @@ def test_sliding_window_timeout_counts_against_budget(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_eval_round_failure_respects_ignore_failed_rounds(tmp_path, monkeypatch):
     cfg = make_cfg(
         tmp_path, n_rounds=1, eval_interval_rounds=1, ignore_failed_rounds=True

@@ -17,8 +17,8 @@ import pytest
 
 from photon_tpu.config.schema import Config
 
-from tests.conftest import free_port as _free_port
-from tests.conftest import subprocess_env as _env
+from tests._helpers import free_port as _free_port
+from tests._helpers import subprocess_env as _env
 
 
 def _cfg(tmp_path) -> Config:
