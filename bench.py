@@ -395,7 +395,16 @@ def _parity_shape(b: int, s: int, h: int, d: int, causal: bool, alibi: bool = Fa
     return res
 
 
-def kernel_parity(full: bool = True) -> dict:
+def _parity_sink(res: dict) -> None:
+    """Atomic incremental write of KERNEL_PARITY.json: the watchdog's SIGKILL
+    can land mid-write, and a truncated artifact is worse than a partial-but-
+    valid one (``complete: false`` marks partials)."""
+    tmp = HERE / "KERNEL_PARITY.json.tmp"
+    tmp.write_text(json.dumps(res, indent=2))
+    os.replace(tmp, HERE / "KERNEL_PARITY.json")
+
+
+def kernel_parity(full: bool = True, sink=None) -> dict:
     """Pallas-vs-XLA parity: forward, backward, and the lse ring inner path.
 
     Base point: the 125M attention shape (bf16, seq 2048, d_head 64).
@@ -410,7 +419,22 @@ def kernel_parity(full: bool = True) -> dict:
     from photon_tpu.ops.flash_attention import flash_attention_with_lse
     from photon_tpu.ops.ring_attention import xla_chunk_attention
 
+    def _provenance(res: dict) -> dict:
+        dev = jax.devices()[0]
+        res["platform"] = dev.platform
+        res["device_kind"] = dev.device_kind
+        res["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        return res
+
+    def _flush(res: dict) -> None:
+        # incremental writes: a hard timeout mid-suite must not lose the
+        # shapes that DID pass (the artifact marks itself partial until done)
+        if sink is not None:
+            sink(_provenance(res))
+
     res = _parity_shape(2, 2048, 12, 64, causal=True)  # 125M recipe shape
+    res["complete"] = False
+    _flush(res)
 
     # lse path (ring inner kernel) vs the XLA chunk oracle on the diagonal
     b, s, h, d = 2, 2048, 12, 64
@@ -434,6 +458,7 @@ def kernel_parity(full: bool = True) -> dict:
     res["lse_fwd_rel_err"] = rel(o_l, o_r)
     res["lse_rel_err"] = rel(lse_l, lse_r)
     res["ok"] = res["ok"] and res["lse_fwd_rel_err"] < 2e-2 and res["lse_rel_err"] < 1e-2
+    _flush(res)
 
     if full:
         extras = {
@@ -447,13 +472,11 @@ def kernel_parity(full: bool = True) -> dict:
             sub = _parity_shape(b, s, h, d, causal, alibi)
             res["extra_shapes"][name] = sub
             res["ok"] = res["ok"] and sub["ok"]
+            _flush(res)
 
-    dev = jax.devices()[0]
-    # provenance so the artifact is auditable on its own (ADVICE r2)
-    res["platform"] = dev.platform
-    res["device_kind"] = dev.device_kind
-    res["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    return res
+    res["complete"] = True
+    _flush(res)
+    return _provenance(res)
 
 
 # ---------------------------------------------------------------------------
@@ -625,13 +648,12 @@ def run(platform: str) -> None:
         trainer.state = None
         t0 = time.perf_counter()
         try:
-            parity = kernel_parity(full=True)
+            parity = kernel_parity(full=True, sink=_parity_sink)
         except Exception as e:  # noqa: BLE001 — parity must not sink the result
             log(f"kernel parity CRASHED: {type(e).__name__}: {e}")
             out["kernel_parity_ok"] = False
             out["kernel_parity_error"] = f"{type(e).__name__}: {e}"[:300]
         else:
-            (HERE / "KERNEL_PARITY.json").write_text(json.dumps(parity, indent=2))
             log(f"kernel parity in {time.perf_counter() - t0:.1f}s: ok={parity['ok']}")
             out["kernel_parity_ok"] = parity["ok"]
         emit(out)
@@ -645,8 +667,7 @@ def main() -> int:
                     help="run only the Pallas-vs-XLA parity check and print its JSON")
     args = ap.parse_args()
     if args.kernel_parity:
-        parity = kernel_parity(full=True)
-        (HERE / "KERNEL_PARITY.json").write_text(json.dumps(parity, indent=2))
+        parity = kernel_parity(full=True, sink=_parity_sink)
         emit(parity)
         return 0 if parity["ok"] else 1
     if args.run:

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Gauntlet-only evaluation of a trained checkpoint (reference:
+# scripts/eval_gauntlet_only.sh — run the ICL Eval Gauntlet against a saved
+# model without training). Scores the shipped 32-task v0.3 corpus
+# (photon_tpu/eval/local_data) with the category-weighted gauntlet config.
+#
+# Usage:
+#   PARAMS_NPZ=/path/params.npz ./scripts/eval_gauntlet_example.sh
+#   STORE=/path/store RUN=my-run-uuid ./scripts/eval_gauntlet_example.sh
+set -euo pipefail
+PRESET=${PRESET:-mpt-125m}
+TOKENIZER=${TOKENIZER:-byte-fallback}
+MAX_ROWS=${MAX_ROWS:-}   # cap rows per task for a quick smoke pass
+
+args=(--preset "$PRESET" --tokenizer "$TOKENIZER")
+if [[ -n "${PARAMS_NPZ:-}" ]]; then
+  args+=(--params-npz "$PARAMS_NPZ")
+elif [[ -n "${STORE:-}" && -n "${RUN:-}" ]]; then
+  args+=(--store "$STORE" --run "$RUN" --round "${ROUND:--1}")
+else
+  echo "set PARAMS_NPZ=... or STORE=...+RUN=... (add ROUND=n for a specific round)" >&2
+  exit 2
+fi
+# the 32-task v0.3 suite + category weights + corpus ship in-repo
+ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+args+=(
+  --tasks-yaml "$ROOT/photon_tpu/eval/configs/tasks_v0.3.yaml"
+  --gauntlet-yaml "$ROOT/photon_tpu/eval/configs/eval_gauntlet_v0.3.yaml"
+  --tasks-root "$ROOT/photon_tpu/eval/local_data"
+)
+if [[ -n "$MAX_ROWS" ]]; then
+  args+=(--icl-max-rows "$MAX_ROWS")
+fi
+exec python -m photon_tpu.eval "${args[@]}" "$@"
