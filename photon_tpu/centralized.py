@@ -17,10 +17,8 @@ import json
 import pathlib
 import time
 
-import numpy as np
 
 from photon_tpu.checkpoint import ClientCheckpointManager, FileStore
-from photon_tpu.codec import ParamsMetadata
 from photon_tpu.config.schema import Config
 from photon_tpu.data import ShardedDataset, StreamingLoader, make_synthetic_dataset
 from photon_tpu.data.loader import ConcatDataset

@@ -20,7 +20,6 @@ import glob
 import json
 import pathlib
 
-import numpy as np
 
 
 def load_params(args):

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import pathlib
-from typing import Any, Callable, Iterable
+from typing import Any, Callable
 
 import numpy as np
 import yaml

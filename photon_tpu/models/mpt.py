@@ -19,7 +19,6 @@ TPU-first design choices (not in the reference):
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any
 
 import flax.linen as nn

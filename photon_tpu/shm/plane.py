@@ -26,7 +26,6 @@ Header: magic ``u32``, version ``u32``, meta_len ``u32``, committed ``u32``.
 
 from __future__ import annotations
 
-import json
 import mmap
 import os
 import pathlib
