@@ -170,7 +170,6 @@ class ClientRuntime:
             )
 
         meta, arrays = self._resolve_params(ins.params)
-        t_set0 = time.monotonic()
 
         # momenta piggybacking: [params|m1|m2] payloads (reference
         # ``manipulate_pre_training_ndarrays``, ``clients/utils.py:405-511``)
@@ -221,10 +220,17 @@ class ClientRuntime:
             # ``clients/utils.py:177-254`` reset_dataset_state semantics)
             loader.skip_samples(state_in.samples_cumulative)
 
+        t_fit0 = time.monotonic()
         fit_metrics = self.trainer.fit(
             loader, ins.local_steps, log_every=cfg.train.log_interval
         )
-        fit_metrics["client/fit_set_parameters_time"] = time.monotonic() - t_set0
+        # reference KPI decomposition (``llm_client_functions.py:161-209``):
+        # init = everything before the train loop (knob validation, param
+        # resolution, momenta split, personalization, loader build/fast-
+        # forward); fit_time = the loop. Trainer.fit itself reports
+        # client/fit_set_parameters_time as the device hand-off alone —
+        # the runtime must not widen that definition (round-4 review).
+        fit_metrics["client/fit_init_time"] = t_fit0 - t_start
 
         out_meta, out_arrays = self.trainer.get_parameters()
         n_samples = ins.local_steps * cfg.train.global_batch_size

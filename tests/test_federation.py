@@ -70,9 +70,13 @@ def test_fed_rounds_end_to_end(tmp_path):
     cfg = make_cfg(tmp_path)
     app = make_app(cfg, tmp_path)
     history = app.run()
-    # three rounds recorded with the reference KPI names
+    # three rounds recorded with the reference KPI names — server-side AND
+    # the client-side timing decomposition (BASELINE.md instrumentation row:
+    # ``llm_client_functions.py:161-209``, ``node_manager_app.py:463-468``)
     for key in ("server/round_time", "server/fit_round_time", "server/broadcast_pre_time",
-                "server/n_clients", "server/pseudo_grad_norm"):
+                "server/n_clients", "server/pseudo_grad_norm",
+                "node_training_time_s", "client/fit_time", "client/fit_init_time",
+                "client/fit_set_parameters_time"):
         assert len(history.series(key)) == 3, key
     assert app.server_steps_cumulative == 3 * cfg.fl.local_steps
     # client states merged for trained cids
