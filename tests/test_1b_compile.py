@@ -1,0 +1,81 @@
+"""The 1B recipe compiles under real multi-chip sharding — abstractly.
+
+BASELINE.md's north star includes "scale to 1.3B across 8 TPU-slice
+clients". Hardware for that doesn't exist here, but the whole sharded
+program can be validated without materializing a single parameter:
+``jax.eval_shape`` builds the abstract TrainState for the ACTUAL mpt-1b
+preset (d2048 / 24L / 16H, seq 2048, vocab 50368, remat on, reference
+``conf/llm_config/mpt-1b.yaml``), GSPMD shardings are derived for an
+fsdp=4 x tensor=2 mesh, and the full train step (microbatch scan + chunked
+CE + AdamW) is lowered and compiled AOT. XLA's memory analysis then bounds
+the per-device footprint — the "does 1B fit on a 16 GB v5e slice" question
+— with zero FLOPs executed.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from photon_tpu.config import load_preset
+from photon_tpu.config.schema import MeshConfig
+
+
+@pytest.mark.slow
+def test_1b_train_step_compiles_sharded():
+    from jax.sharding import NamedSharding
+
+    from photon_tpu.models.mpt import MPTModel, init_params
+    from photon_tpu.optim import build_optimizer
+    from photon_tpu.parallel.mesh import make_mesh
+    from photon_tpu.parallel.sharding import batch_spec, state_shardings
+    from photon_tpu.train.train_step import init_train_state, make_train_step
+
+    cfg = load_preset("mpt-1b")
+    cfg.mesh = MeshConfig(fsdp=4, tensor=2)
+    cfg.model.attn_impl = "xla"  # pallas needs a real TPU; sharding is identical
+    cfg.validate()
+
+    mesh = make_mesh(cfg.mesh)
+    model = MPTModel(cfg.model)
+    tx, _ = build_optimizer(cfg.optimizer, cfg.scheduler)
+
+    abstract_state = jax.eval_shape(
+        lambda: init_train_state(model, tx, init_params(cfg.model, seed=0))
+    )
+    n_params = sum(
+        int(np.prod(l.shape)) for l in jax.tree.leaves(abstract_state.params)
+    )
+    assert 1.2e9 < n_params < 1.5e9, f"{n_params:,} params is not the 1B recipe"
+
+    dp = cfg.mesh.data * cfg.mesh.fsdp
+    micro = cfg.train.device_microbatch_size  # 4, per the reference recipe
+    n_micro = cfg.train.global_batch_size // (micro * dp)  # 512 / 16 = 32
+    step = make_train_step(model, tx, n_microbatches=n_micro,
+                           loss_chunk_tokens=cfg.train.loss_chunk_tokens)
+
+    shardings = state_shardings(abstract_state, mesh)
+    batch_sh = NamedSharding(mesh, batch_spec(mesh))
+    tokens = jax.ShapeDtypeStruct(
+        (cfg.train.global_batch_size, cfg.model.max_seq_len), np.int32,
+        sharding=batch_sh,
+    )
+    jitted = jax.jit(
+        step, in_shardings=(shardings, batch_sh), out_shardings=(shardings, None),
+        donate_argnums=0,
+    )
+    compiled = jitted.lower(abstract_state, tokens).compile()
+
+    # XLA's own accounting: sharded params + optimizer state + activations
+    # must fit a 16 GB v5e chip with headroom for the runtime. (On the CPU
+    # backend the analysis covers one device's share of the SPMD program.)
+    mem = compiled.memory_analysis()
+    if mem is not None:  # backend-dependent availability
+        # donated state aliases into the output (alias_size covers it), so
+        # live bytes = args + temps + any non-aliased output
+        per_dev_gb = (mem.argument_size_in_bytes + mem.temp_size_in_bytes
+                      + mem.output_size_in_bytes - mem.alias_size_in_bytes) / 2**30
+        # measured (PERF.md "1B per-device memory"): ~12.6 GiB at the
+        # reference recipe (micro 4, remat, chunked CE) on fsdp4 x tensor2 —
+        # fits a 16 GiB v5e with runtime headroom. fsdp8-without-TP is the
+        # config that does NOT fit (~35 GiB: full-width gathered weights).
+        assert per_dev_gb < 14.0, f"{per_dev_gb:.1f} GiB/device exceeds v5e headroom"
