@@ -1,15 +1,15 @@
-"""The 1B recipe compiles under real multi-chip sharding — abstractly.
+"""The 1B/3B/7B recipes compile under real multi-chip sharding — abstractly.
 
 BASELINE.md's north star includes "scale to 1.3B across 8 TPU-slice
 clients". Hardware for that doesn't exist here, but the whole sharded
-program can be validated without materializing a single parameter:
-``jax.eval_shape`` builds the abstract TrainState for the ACTUAL mpt-1b
-preset (d2048 / 24L / 16H, seq 2048, vocab 50368, remat on, reference
-``conf/llm_config/mpt-1b.yaml``), GSPMD shardings are derived for an
-fsdp=4 x tensor=2 mesh, and the full train step (microbatch scan + chunked
-CE + AdamW) is lowered and compiled AOT. XLA's memory analysis then bounds
-the per-device footprint — the "does 1B fit on a 16 GB v5e slice" question
-— with zero FLOPs executed.
+program is validated without materializing a single parameter:
+``jax.eval_shape`` builds the abstract TrainState for the ACTUAL preset
+(reference ``conf/llm_config/mpt-1b.yaml`` etc.), GSPMD shardings are
+derived for the mesh, and the full train step (microbatch scan + chunked CE
++ optimizer) is lowered and compiled AOT. XLA's memory analysis then bounds
+the per-device footprint — the "does it fit a 16 GiB v5e" question — with
+zero FLOPs executed. The fitting meshes and the widen-tensor-not-fsdp rule
+they expose are recorded in PERF.md ("1B per-device memory").
 """
 
 import jax
@@ -20,8 +20,19 @@ from photon_tpu.config import load_preset
 from photon_tpu.config.schema import MeshConfig
 
 
-@pytest.mark.slow
-def test_1b_train_step_compiles_sharded():
+@pytest.mark.parametrize(
+    "preset,mesh_kw,micro,params_range",
+    [
+        # reference recipe micro=4 measures 12.6 GiB/device on 8 chips
+        ("mpt-1b", dict(fsdp=4, tensor=2), 4, (1.2e9, 1.5e9)),
+        # 3B fits ONE 8-chip v5e slice at micro 2
+        ("mpt-3b", dict(fsdp=4, tensor=2), 2, (2.4e9, 2.9e9)),
+        # 7B needs 32 chips; fsdp8xtp4 fits where fsdp16xtp2 (36 GiB) won't
+        ("mpt-7b", dict(fsdp=8, tensor=4), 2, (6.2e9, 7.2e9)),
+    ],
+    ids=["1b-8dev", "3b-8dev", "7b-32dev"],
+)
+def test_preset_train_step_compiles_sharded(preset, mesh_kw, micro, params_range):
     from jax.sharding import NamedSharding
 
     from photon_tpu.models.mpt import MPTModel, init_params
@@ -30,9 +41,19 @@ def test_1b_train_step_compiles_sharded():
     from photon_tpu.parallel.sharding import batch_spec, state_shardings
     from photon_tpu.train.train_step import init_train_state, make_train_step
 
-    cfg = load_preset("mpt-1b")
-    cfg.mesh = MeshConfig(fsdp=4, tensor=2)
-    cfg.model.attn_impl = "xla"  # pallas needs a real TPU; sharding is identical
+    cfg = load_preset(preset)
+    cfg.mesh = MeshConfig(**mesh_kw)
+    n_dev = 1
+    for v in cfg.mesh.axis_sizes().values():
+        n_dev *= v
+    if n_dev > len(jax.devices()):
+        # conftest pins 8 virtual devices; the 32-device case builds a mesh
+        # from a device array reshaped beyond the host count — skip there
+        # (the 8-dev cases cover the mechanism; PERF.md records the 32-dev
+        # analysis from a jax_num_cpu_devices=32 session)
+        pytest.skip(f"needs {n_dev} devices, have {len(jax.devices())}")
+    cfg.model.attn_impl = "xla"  # pallas needs a real TPU; sharding identical
+    cfg.train.device_microbatch_size = micro
     cfg.validate()
 
     mesh = make_mesh(cfg.mesh)
@@ -45,11 +66,11 @@ def test_1b_train_step_compiles_sharded():
     n_params = sum(
         int(np.prod(l.shape)) for l in jax.tree.leaves(abstract_state.params)
     )
-    assert 1.2e9 < n_params < 1.5e9, f"{n_params:,} params is not the 1B recipe"
+    lo, hi = params_range
+    assert lo < n_params < hi, f"{n_params:,} params is not the {preset} recipe"
 
     dp = cfg.mesh.data * cfg.mesh.fsdp
-    micro = cfg.train.device_microbatch_size  # 4, per the reference recipe
-    n_micro = cfg.train.global_batch_size // (micro * dp)  # 512 / 16 = 32
+    n_micro = max(cfg.train.global_batch_size // (micro * dp), 1)
     step = make_train_step(model, tx, n_microbatches=n_micro,
                            loss_chunk_tokens=cfg.train.loss_chunk_tokens)
 
@@ -65,17 +86,10 @@ def test_1b_train_step_compiles_sharded():
     )
     compiled = jitted.lower(abstract_state, tokens).compile()
 
-    # XLA's own accounting: sharded params + optimizer state + activations
-    # must fit a 16 GB v5e chip with headroom for the runtime. (On the CPU
-    # backend the analysis covers one device's share of the SPMD program.)
     mem = compiled.memory_analysis()
     if mem is not None:  # backend-dependent availability
         # donated state aliases into the output (alias_size covers it), so
         # live bytes = args + temps + any non-aliased output
         per_dev_gb = (mem.argument_size_in_bytes + mem.temp_size_in_bytes
                       + mem.output_size_in_bytes - mem.alias_size_in_bytes) / 2**30
-        # measured (PERF.md "1B per-device memory"): ~12.6 GiB at the
-        # reference recipe (micro 4, remat, chunked CE) on fsdp4 x tensor2 —
-        # fits a 16 GiB v5e with runtime headroom. fsdp8-without-TP is the
-        # config that does NOT fit (~35 GiB: full-width gathered weights).
         assert per_dev_gb < 14.0, f"{per_dev_gb:.1f} GiB/device exceeds v5e headroom"
