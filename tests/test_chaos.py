@@ -1,0 +1,82 @@
+"""Elasticity soak: random client failures across many rounds.
+
+SURVEY §5 "Failure detection / elastic recovery": the reference recovers
+round-by-round (failed task re-queued, worker restarted, failure budget).
+The targeted failure tests cover each mechanism once; this soak drives the
+WHOLE loop through sustained, randomized chaos — a different client failing
+on its first attempt in every round, some rounds failing outright — and
+asserts the run still completes, aggregates every round from the surviving
+clients, and keeps training signal flowing (param norms finite, pseudo-grad
+norms > 0, cumulative steps advancing only for completed rounds).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from photon_tpu.federation.messages import FitRes
+from tests.test_federation import make_app, make_cfg
+
+
+@pytest.mark.slow
+def test_soak_random_failures_across_rounds(tmp_path):
+    n_rounds = 6
+    cfg = make_cfg(
+        tmp_path,
+        n_rounds=n_rounds,
+        n_total_clients=4,
+        n_clients_per_round=3,
+        accept_failures_cnt=1,   # one PERSISTENT failure tolerated per round
+        ignore_failed_rounds=True,
+    )
+    app = make_app(cfg, tmp_path, n_nodes=2)
+
+    rng = random.Random(1234)
+    chaos = {"first_attempt_fails": set(), "hard_fails": set()}
+    for rnd in range(1, n_rounds + 1):
+        # every round: one cid flakes once (must be retried and aggregated);
+        # some rounds: one cid fails BOTH attempts (eats the failure budget)
+        chaos["first_attempt_fails"].add((rnd, rng.randrange(4)))
+        if rng.random() < 0.5:
+            chaos["hard_fails"].add((rnd, rng.randrange(4)))
+
+    attempts: dict[tuple[int, int], int] = {}
+    for agent in app.driver._agents.values():
+        orig_fit = agent.runtime.fit
+
+        def fit(ins, cid, _orig=orig_fit):
+            key = (ins.server_round, cid)
+            attempts[key] = attempts.get(key, 0) + 1
+            if key in chaos["hard_fails"]:
+                return FitRes(ins.server_round, cid, None, error="chaos-hard")
+            if key in chaos["first_attempt_fails"] and attempts[key] == 1:
+                return FitRes(ins.server_round, cid, None, error="chaos-flaky")
+            return _orig(ins, cid)
+
+        agent.runtime.fit = fit
+
+    history = app.run()
+    app.driver.shutdown()
+
+    rounds_failed = {r for r, _ in history.series("server/round_failed")}
+    rounds_ok = [r for r, _ in history.series("server/n_clients")]
+    assert len(rounds_ok) + len(rounds_failed) == n_rounds
+    # flaky-only rounds MUST complete (retry-once absorbs the first failure)
+    for rnd in range(1, n_rounds + 1):
+        sampled_hard = any(r == rnd for r, _ in chaos["hard_fails"])
+        if not sampled_hard:
+            assert rnd in rounds_ok, f"round {rnd} had only flaky failures"
+    # training signal flowed every completed round
+    for rnd, norm in history.series("server/pseudo_grad_norm"):
+        assert np.isfinite(norm) and norm > 0
+    # steps advance exactly once per completed round
+    steps = dict(history.series("server/steps_cumulative"))
+    assert app.server_steps_cumulative == len(rounds_ok) * cfg.fl.local_steps
+    assert steps[rounds_ok[-1]] == app.server_steps_cumulative
+    # retried flaky cids were attempted at least twice in completed rounds
+    for (rnd, cid) in chaos["first_attempt_fails"]:
+        if rnd in rounds_ok and (rnd, cid) not in chaos["hard_fails"]:
+            # only sampled cids get attempts; if sampled, retry happened
+            if (rnd, cid) in attempts:
+                assert attempts[(rnd, cid)] >= 2, (rnd, cid)
