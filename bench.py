@@ -44,6 +44,8 @@ PHOTON_BENCH_REMAT=1 (force activation checkpointing),
 PHOTON_BENCH_CAP (auto-probe start cap, default 16),
 PHOTON_BENCH_PLATFORM (skip straight to tpu|cpu),
 PHOTON_BENCH_SKIP_PARITY=1 (skip the kernel parity check),
+PHOTON_BENCH_SECOND_MICRO (pinned-config second microbatch trial after the
+first emit; default 2x the pinned micro, 0 disables),
 PHOTON_BENCH_SKIP_SWEEP=1 (skip the microbatch sweep),
 PHOTON_BENCH_PROFILE=1 (write a jax.profiler trace of the timed window).
 """
@@ -569,6 +571,31 @@ def run(platform: str) -> None:
     warm(trainer)
     micro = trainer.device_microbatch_size
 
+    def try_candidate(micro_c: int, n_timed: int, free_current_first: bool):
+        """Build + warm + time a candidate trainer at ``micro_c``. Returns
+        ``(trainer, dt, loss)`` or None; frees the candidate's HBM on
+        failure. ``free_current_first`` drops the current trainer's state
+        before the build (two resident TrainStates double HBM pressure and
+        can shift timings or OOM — ADVICE r3); only safe once the current
+        result no longer needs re-timing."""
+        cfg_c = Config.from_dict(cfg.to_dict())
+        cfg_c.model.attn_impl = cfg.model.attn_impl
+        cfg_c.train.device_microbatch_size = micro_c
+        t_c = None
+        try:
+            if free_current_first:
+                trainer.state = None
+            t_c = _build_trainer(cfg_c.validate(), mesh)
+            warm(t_c)
+            dt_c, loss_c = _timed_window(t_c, batch, n_timed)
+            return t_c, dt_c, loss_c
+        except Exception as e:  # noqa: BLE001 — candidate trials are best-effort
+            if t_c is not None:
+                t_c.state = None  # free the failed candidate's HBM
+            log(f"micro={micro_c} candidate failed ({type(e).__name__}: {e}); "
+                f"keeping micro={micro}")
+            return None
+
     # quick sweep: the largest fitting microbatch is not always the fastest
     # (pre-chunked-CE measurements had micro=2 beating 8 by 40%); try M/2
     if (
@@ -578,28 +605,17 @@ def run(platform: str) -> None:
         and on_tpu
     ):
         dt_cur, _ = _timed_window(trainer, batch, 2)
-        cfg_half = Config.from_dict(cfg.to_dict())
-        cfg_half.model.attn_impl = cfg.model.attn_impl
-        cfg_half.train.device_microbatch_size = micro // 2
-        t_half = None
-        try:
-            t_half = _build_trainer(cfg_half.validate(), mesh)
-            warm(t_half)
-            dt_half, _ = _timed_window(t_half, batch, 2)
+        cand = try_candidate(micro // 2, n_timed=2, free_current_first=False)
+        if cand is not None:
+            t_half, dt_half, _ = cand
             log(f"sweep: micro={micro}: {dt_cur:.2f}s/2-step, micro={micro // 2}: {dt_half:.2f}s")
-            # free the LOSER's device state before the measured window — two
-            # resident TrainStates double HBM pressure and can shift timings
-            # or OOM the final window in memory-marginal configs (ADVICE r3)
+            # free the LOSER's device state before the measured window
             if dt_half < dt_cur:
                 trainer.state = None
                 trainer, micro = t_half, micro // 2
             else:
                 t_half.state = None
                 del t_half
-        except Exception as e:  # noqa: BLE001 — sweep is best-effort
-            if t_half is not None:
-                t_half.state = None  # free the failed candidate's HBM too
-            log(f"sweep candidate failed ({type(e).__name__}); keeping micro={micro}")
 
     n_steps = max(1, int(os.environ.get("PHOTON_BENCH_STEPS", "6" if on_tpu else "2")))
     profile = os.environ.get("PHOTON_BENCH_PROFILE") == "1" and on_tpu
@@ -643,6 +659,38 @@ def run(platform: str) -> None:
     # (the supervisor salvages the last emitted metric line on stall; a
     # second emit below upgrades it with kernel_parity_ok)
     emit(out)
+
+    # Pinned-config micro trial: bench_tuned.json pins micro=2 from the
+    # PRE-chunked-CE hardware session, where the [micro·2047, vocab] fp32
+    # logits made small microbatches faster. Chunked CE removed that sink,
+    # so a larger microbatch may now win — try 2·micro AFTER the safe number
+    # is emitted; any improvement re-emits, any failure keeps the result.
+    second = os.environ.get("PHOTON_BENCH_SECOND_MICRO", "")
+    if on_tpu and pinned and second != "0":
+        micro2 = int(second) if second else 2 * micro
+        if micro2 != micro and gbs % micro2 == 0:
+            cand = try_candidate(micro2, n_timed=n_steps, free_current_first=True)
+            if cand is not None:
+                t2, dt2, loss2 = cand
+                tps2 = n_steps * gbs * seq / dt2
+                log(f"second-micro trial: micro={micro2}: {tps2:,.0f} tok/s "
+                    f"vs micro={micro}: {toks_per_sec:,.0f}")
+                if tps2 > toks_per_sec:
+                    trainer, micro = t2, micro2
+                    toks_per_sec, loss = tps2, loss2
+                    mfu = toks_per_sec * flops_per_tok / peak
+                    out.update({
+                        "value": round(toks_per_sec, 1),
+                        "vs_baseline": round(toks_per_sec / A100_EST_TOKENS_PER_SEC, 4),
+                        "mfu": round(mfu, 4),
+                        "microbatch": micro,
+                        "final_loss": round(loss, 3),
+                    })
+                    emit(out)
+                else:
+                    t2.state = None
+                    del t2
+
     if on_tpu and os.environ.get("PHOTON_BENCH_SKIP_PARITY") != "1":
         # free the trainer's HBM first — parity allocates its own test tensors
         trainer.state = None

@@ -34,12 +34,22 @@ def test_soak_random_failures_across_rounds(tmp_path):
 
     rng = random.Random(1234)
     chaos = {"first_attempt_fails": set(), "hard_fails": set()}
+    blackout_rounds = set()
     for rnd in range(1, n_rounds + 1):
         # every round: one cid flakes once (must be retried and aggregated);
-        # some rounds: one cid fails BOTH attempts (eats the failure budget)
+        # some rounds: ONE cid fails both attempts (absorbed by the budget);
+        # some rounds: THREE of four cids hard-fail — with 3 sampled per
+        # round at least two are hit, the budget (1) is exceeded, and the
+        # ignore_failed_rounds recovery path must carry the run onward
         chaos["first_attempt_fails"].add((rnd, rng.randrange(4)))
-        if rng.random() < 0.5:
+        roll = rng.random()
+        if roll < 0.3:
+            blackout_rounds.add(rnd)
+            for cid in rng.sample(range(4), 3):
+                chaos["hard_fails"].add((rnd, cid))
+        elif roll < 0.6:
             chaos["hard_fails"].add((rnd, rng.randrange(4)))
+    assert blackout_rounds, "seed must schedule at least one blackout round"
 
     attempts: dict[tuple[int, int], int] = {}
     for agent in app.driver._agents.values():
@@ -62,6 +72,11 @@ def test_soak_random_failures_across_rounds(tmp_path):
     rounds_failed = {r for r, _ in history.series("server/round_failed")}
     rounds_ok = [r for r, _ in history.series("server/n_clients")]
     assert len(rounds_ok) + len(rounds_failed) == n_rounds
+    # blackout rounds (>=2 of 3 sampled cids hard-failing) MUST exceed the
+    # budget and be recorded failed — proving ignore_failed_rounds recovery
+    # actually ran, not just that chaos was survivable
+    assert blackout_rounds <= rounds_failed, (blackout_rounds, rounds_failed)
+    assert rounds_ok, "every round failed — chaos schedule too aggressive"
     # flaky-only rounds MUST complete (retry-once absorbs the first failure)
     for rnd in range(1, n_rounds + 1):
         sampled_hard = any(r == rnd for r, _ in chaos["hard_fails"])
