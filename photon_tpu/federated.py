@@ -39,6 +39,18 @@ def build_app(
     multiprocess: bool = False,
     tcp_listen: str | None = None,
 ) -> ServerApp:
+    if cfg.photon.comm_stack.collective:
+        # the collective plane is a DIFFERENT topology (multi-controller
+        # SPMD, no server process) — fail loudly instead of silently falling
+        # back to a pointer plane (the silent-no-op class FitRoundConfig
+        # exists to eliminate)
+        raise ValueError(
+            "photon.comm_stack.collective uses the multi-controller SPMD "
+            "topology: launch `python -m photon_tpu.federation.collective_round "
+            "--coordinator host:port --num-processes N --process-id i "
+            "--config ...` on every slice instead of the driver-based "
+            "federated CLI (see photon_tpu/federation/collective_round.py)"
+        )
     save = pathlib.Path(cfg.photon.save_path)
     save.mkdir(parents=True, exist_ok=True)
 

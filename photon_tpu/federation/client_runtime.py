@@ -54,12 +54,17 @@ class ClientRuntime:
         transport: ParamTransport,
         node_id: str = "node0",
         ckpt_mgr: ClientCheckpointManager | None = None,
+        mesh=None,
     ) -> None:
         self.cfg = cfg
         self.transport = transport
         self.node_id = node_id
         self.ckpt_mgr = ckpt_mgr
-        self.trainer = Trainer(cfg)
+        # ``mesh`` pins the trainer to specific devices — required under
+        # jax.distributed, where the default mesh would span other
+        # processes' non-addressable devices (collective_round passes the
+        # process-local devices)
+        self.trainer = Trainer(cfg, mesh=mesh)
         self._loaders: dict[tuple[int, str], StreamingLoader] = {}
         self._histories: dict[int, Any] = {}  # per-cid metric history
         self._current_params: tuple[ParamsMetadata, list[np.ndarray]] | None = None

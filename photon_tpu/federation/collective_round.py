@@ -1,0 +1,277 @@
+"""Federated rounds over XLA collectives — the TPU-native comm stack.
+
+Consumer of ``photon.comm_stack.collective`` (SURVEY §7 stage 6, the marquee
+path): where the driver topology moves every client's parameters through a
+pointer plane (shm / objstore) and averages on the server host
+(``strategy/aggregation.py``), slices that are part of one
+``jax.distributed`` job aggregate with a weighted ``psum`` over a
+``clients`` mesh axis (``parallel/collective_agg.py``) — no host round-trip,
+no object store; the replicated result doubles as the next round's
+broadcast (reference upload/download + broadcast:
+``s3_utils.py:730-1115``, ``broadcast_utils.py:60-201``).
+
+Topology: multi-controller SPMD. Every process runs THIS SAME loop over its
+local clients; there is no server process. Each controller holds a replica
+of the strategy and applies the identical deterministic update
+(``Strategy.apply_average``) to the psum'd average, so all replicas march in
+lockstep — divergence would desync the next psum, which is why client
+failures here are fatal rather than budgeted (the NCCL-gang tradeoff:
+bandwidth for elasticity; the driver topology keeps the failure budget).
+
+Client training itself reuses ``ClientRuntime`` end to end (persistent
+Trainer, per-cid loaders, reset knobs, step injection), so data order and
+numerics match the driver path exactly — asserted by
+``tests/test_collective_round.py``.
+
+Launch (one line per host/slice, mirroring the reference's multi-node flow
+``scripts/fed_125m_example.sh:104-137``):
+
+    python -m photon_tpu.federation.collective_round \
+        --coordinator host0:1234 --num-processes 2 --process-id {0,1} \
+        --config /shared/run/config.yaml
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from photon_tpu.codec import params_to_ndarrays
+from photon_tpu.config.schema import Config
+from photon_tpu.federation.client_runtime import ClientRuntime
+from photon_tpu.federation.messages import FitIns
+from photon_tpu.federation.transport import ParamTransport
+from photon_tpu.metrics.history import History
+from photon_tpu.parallel.collective_agg import (
+    CLIENT_AXIS,
+    collective_weighted_average,
+    make_client_mesh,
+)
+from photon_tpu.strategy import dispatch_strategy
+
+
+def partition_cids(n_total_clients: int, num_processes: int, process_id: int) -> list[int]:
+    """Contiguous, process-ordered cid partition. The order is load-bearing:
+    global stacked row ``i`` must live on the i-th device of the client mesh,
+    and mesh devices enumerate process 0's devices first."""
+    per = n_total_clients // num_processes
+    rem = n_total_clients % num_processes
+    start = process_id * per + min(process_id, rem)
+    count = per + (1 if process_id < rem else 0)
+    return list(range(start, start + count))
+
+
+class CollectiveFedRunner:
+    """Multi-controller federated loop: local fits → psum average → replica
+    strategy update, every round, on every process."""
+
+    def __init__(self, cfg: Config, process_cids: Sequence[int], mesh=None) -> None:
+        if not cfg.photon.comm_stack.collective:
+            raise ValueError("CollectiveFedRunner requires photon.comm_stack.collective=true")
+        if cfg.fl.n_clients_per_round != cfg.fl.n_total_clients:
+            # lockstep psum = full participation by construction; a sampled
+            # subset is the driver topology's feature. Fail loudly instead of
+            # silently training more clients than the config states.
+            raise ValueError(
+                f"collective mode trains ALL clients every round; "
+                f"n_clients_per_round={cfg.fl.n_clients_per_round} != "
+                f"n_total_clients={cfg.fl.n_total_clients} (use the driver "
+                "topology for client sampling)"
+            )
+        self.cfg = cfg
+        self.process_cids = list(process_cids)
+        if not self.process_cids:
+            raise ValueError(
+                "this process owns no clients — launch with num_processes <= "
+                "n_total_clients so every controller contributes psum rows"
+            )
+        self.mesh = mesh if mesh is not None else self._default_mesh()
+        # inline transport: params never leave this process except via psum
+        self.transport = ParamTransport("inline")
+        from photon_tpu.parallel.mesh import single_device_mesh
+
+        # the client trainer must live on THIS process's devices only —
+        # jax.devices() is global under jax.distributed
+        self.runtime = ClientRuntime(
+            cfg,
+            self.transport,
+            node_id=f"collective{jax.process_index()}",
+            mesh=single_device_mesh(jax.local_devices()[0]),
+        )
+        self.strategy = dispatch_strategy(cfg.fl)
+        from photon_tpu.models.mpt import init_params
+
+        self.meta, initial = params_to_ndarrays(init_params(cfg.model, seed=cfg.seed))
+        self.strategy.initialize(initial)
+        self.history = History()
+        self.server_steps_cumulative = 0
+        self._warmup_collective()
+
+    def _warmup_collective(self) -> None:
+        """Establish the cross-process collective context BEFORE the first
+        round's fits: context initialization has a hard handshake deadline
+        (Gloo: 30 s on CPU), and round-boundary arrival skew easily exceeds
+        it when the first fit compiles. All controllers construct the runner
+        near-simultaneously, so a tiny psum here creates the context while
+        everyone is at the same line; later collectives reuse it and wait as
+        long as the slowest controller needs."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n = self.cfg.fl.n_total_clients
+        sharding = NamedSharding(self.mesh, P(CLIENT_AXIS))
+        ones = jax.make_array_from_process_local_data(
+            sharding, np.ones(len(self.process_cids), np.int32), (n,)
+        )
+        probe = jax.make_array_from_process_local_data(
+            sharding, np.ones((len(self.process_cids), 1), np.float32), (n, 1)
+        )
+        avg = collective_weighted_average([probe], ones, self.mesh)
+        np.asarray(avg[0])  # block: the context exists once this returns
+
+    def _default_mesh(self):
+        """Client mesh whose device order matches :func:`partition_cids`:
+        row i of the stacked arrays must land on a device ADDRESSABLE by the
+        process that owns cid i, and every process must contribute exactly
+        ``len(process_cids)`` devices — ``jax.devices()[:n]`` breaks both
+        whenever local device counts differ from local cid counts (e.g. 2
+        hosts x 4 chips with 4 clients)."""
+        n_total = self.cfg.fl.n_total_clients
+        n_proc = jax.process_count()
+        devices = []
+        for p in range(n_proc):
+            want = len(partition_cids(n_total, n_proc, p))
+            local = [d for d in jax.devices() if d.process_index == p]
+            if len(local) < want:
+                raise ValueError(
+                    f"process {p} owns {want} clients but only {len(local)} "
+                    f"devices — rebalance clients or add devices"
+                )
+            devices.extend(local[:want])
+        return make_client_mesh(n_total, devices)
+
+    # ------------------------------------------------------------------
+    def _stack_local(self, rows: list[list[np.ndarray]]) -> list[jax.Array]:
+        """Per-layer: process-local ``[n_local, ...]`` rows → global
+        ``[n_clients, ...]`` client-axis-sharded arrays."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self.mesh, P(CLIENT_AXIS))
+        n_global = self.cfg.fl.n_total_clients
+        out = []
+        for li in range(len(rows[0])):
+            local = np.stack([r[li] for r in rows])
+            gshape = (n_global,) + local.shape[1:]
+            out.append(
+                jax.make_array_from_process_local_data(sharding, local, gshape)
+            )
+        return out
+
+    def run_round(self, server_round: int) -> dict[str, float]:
+        t_round = time.monotonic()
+        cfg = self.cfg
+
+        # "broadcast": every controller already holds the replica params
+        ptr = self.transport.put(
+            f"collective-bcast-r{server_round}", self.meta, self.strategy.current_parameters
+        )
+        self.runtime.set_broadcast_params(ptr)
+
+        # matches the driver topology's definition: fit_round_time spans the
+        # client fits AND the aggregation (server.py fit_round)
+        t_fit = time.monotonic()
+        rows: list[list[np.ndarray]] = []
+        ns: list[int] = []
+        for cid in self.process_cids:
+            ins = FitIns(
+                server_round=server_round,
+                cids=[cid],
+                params=None,
+                local_steps=cfg.fl.local_steps,
+                server_steps_cumulative=self.server_steps_cumulative,
+                config=dict(cfg.fl.fit_config),
+            )
+            res = self.runtime.fit(ins, cid)
+            if res.error:
+                # lockstep psum: a missing contribution cannot be budgeted
+                # away mid-program (see module docstring)
+                raise RuntimeError(
+                    f"collective round {server_round}: cid {cid} failed: {res.error}"
+                )
+            _, arrays = self.transport.get(res.params)
+            rows.append(arrays)
+            ns.append(res.n_samples)
+            self.transport.free(res.params)
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        stacked = self._stack_local(rows)
+        ns_global = jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, P(CLIENT_AXIS)),
+            np.asarray(ns, np.int32),
+            (cfg.fl.n_total_clients,),
+        )
+        t_agg = time.monotonic()
+        # Σn rides the same SPMD program as one extra psum output — a
+        # separate collective per round would double the rendezvous cost
+        avg_dev, total_dev = collective_weighted_average(
+            stacked, ns_global, self.mesh, return_total=True
+        )
+        # replicated outputs → every controller fetches identical values
+        avg = [np.asarray(a) for a in avg_dev]
+        n_total = int(np.asarray(total_dev))
+
+        metrics = self.strategy.apply_average(
+            server_round, avg, n_total, cfg.fl.n_total_clients
+        )
+        metrics["server/collective_agg_time"] = time.monotonic() - t_agg
+        metrics["server/fit_round_time"] = time.monotonic() - t_fit
+        self.server_steps_cumulative += cfg.fl.local_steps
+        metrics["server/steps_cumulative"] = float(self.server_steps_cumulative)
+        metrics["server/round_time"] = time.monotonic() - t_round
+        self.history.record(server_round, metrics)
+        return metrics
+
+    def run(self, n_rounds: int | None = None) -> History:
+        n_rounds = n_rounds if n_rounds is not None else self.cfg.fl.n_rounds
+        for rnd in range(1, n_rounds + 1):
+            self.run_round(rnd)
+        return self.history
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="photon_tpu.federation.collective_round",
+        description="multi-controller federated rounds over XLA collectives",
+    )
+    ap.add_argument("--coordinator", required=True, help="host:port of process 0")
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--config", required=True, help="resolved config YAML")
+    ap.add_argument("--rounds", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    jax.distributed.initialize(
+        args.coordinator, num_processes=args.num_processes, process_id=args.process_id
+    )
+    cfg = Config.from_yaml(args.config)
+    cfg.photon.comm_stack.collective = True
+    cfg.validate()
+    cids = partition_cids(cfg.fl.n_total_clients, args.num_processes, args.process_id)
+    runner = CollectiveFedRunner(cfg, cids)
+    history = runner.run(args.rounds)
+    out = {"rounds": args.rounds or cfg.fl.n_rounds, "process_id": args.process_id}
+    for key in ("server/round_time", "server/pseudo_grad_norm", "server/steps_cumulative"):
+        latest = history.latest(key)
+        if latest is not None:
+            out[key] = latest
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,7 @@ def collective_weighted_average(
     stacked_params: Any,
     n_samples: jax.Array,
     mesh: Mesh,
+    return_total: bool = False,
 ) -> Any:
     """Sample-weighted average over the client axis, one psum per pytree.
 
@@ -57,24 +58,31 @@ def collective_weighted_average(
     Returns the averaged pytree (leaves ``[...]``, replicated) — every client
     slice ends the round holding identical new globals, which also replaces
     the reference's post-aggregation broadcast (``broadcast_utils.py``).
+    With ``return_total`` the replicated Σn rides the SAME program as one
+    extra psum output (callers need it for metrics; a separate collective
+    per round would be a second trace + cross-process rendezvous).
     """
 
     def local(ns, *leaves):
-        # ns: [1] local sample count; leaves: [1, ...] local client rows
-        n_total = jax.lax.psum(ns[0].astype(jnp.float32), CLIENT_AXIS)
+        # ns: [n_local] local sample counts; leaves: [n_local, ...] rows
+        n_total = jax.lax.psum(jnp.sum(ns.astype(jnp.float32)), CLIENT_AXIS)
         w = ns[0].astype(jnp.float32) / n_total
-        return tuple(
+        outs = tuple(
             jax.lax.psum(leaf[0].astype(jnp.float32) * w, CLIENT_AXIS) for leaf in leaves
         )
+        return outs + (n_total,)
 
     flat, treedef = jax.tree_util.tree_flatten(stacked_params)
     out_flat = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(CLIENT_AXIS),) + tuple(P(CLIENT_AXIS) for _ in flat),
-        out_specs=tuple(P() for _ in flat),
+        out_specs=tuple(P() for _ in flat) + (P(),),
     )(n_samples, *flat)
-    return jax.tree_util.tree_unflatten(treedef, list(out_flat))
+    avg = jax.tree_util.tree_unflatten(treedef, list(out_flat[:-1]))
+    if return_total:
+        return avg, out_flat[-1]
+    return avg
 
 
 def collective_fedavg_round(

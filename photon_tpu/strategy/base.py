@@ -104,9 +104,36 @@ class Strategy:
                 yield r.arrays, r.n_samples
 
         avg, n_total = aggregate_inplace(stream())
-        n_clients = len(seen)
+        metrics = self.apply_average(server_round, avg, n_total, len(seen))
+        metrics.update(weighted_average_metrics(seen))
+        return self.current_parameters, metrics
 
-        # pseudo-gradient per layer
+    def apply_average(
+        self,
+        server_round: int,
+        avg: list[np.ndarray],
+        n_total: int,
+        n_clients: int,
+    ) -> dict[str, float]:
+        """Post-average half of the round: pseudo-gradient → server optimizer
+        → telemetry. Shared by the host streaming path (:meth:`aggregate_fit`)
+        and the on-device collective path
+        (``photon_tpu/federation/collective_round.py``), where the weighted
+        average arrives from a DCN/ICI psum instead of ``aggregate_inplace``
+        — every controller applies this identical deterministic update to its
+        strategy replica."""
+        if self.current_parameters is None:
+            raise RuntimeError("strategy not initialized with parameters")
+        if len(avg) != len(self.current_parameters):
+            # zip() would silently truncate — e.g. a [params|m1|m2] momenta
+            # payload averaged against momenta-less current_parameters
+            raise ValueError(
+                f"averaged payload has {len(avg)} arrays, strategy holds "
+                f"{len(self.current_parameters)} (momenta mismatch? the "
+                "server extends initial params with zero momenta when "
+                "aggregate_momenta is on)"
+            )
+        self.server_round = server_round
         pseudo_grad = [x - a for x, a in zip(self.current_parameters, avg)]
         lr = self.effective_lr(n_clients)
         new_params = self.server_update(pseudo_grad, lr)
@@ -118,9 +145,8 @@ class Strategy:
         }
         if self.telemetry:
             metrics.update(self.norm_telemetry(pseudo_grad))
-        metrics.update(weighted_average_metrics(seen))
         self.current_parameters = new_params
-        return new_params, metrics
+        return metrics
 
     def aggregate_evaluate(
         self, server_round: int, results: Iterable[tuple[int, float, dict[str, float]]]
