@@ -64,6 +64,11 @@ class ModelConfig:
     emb_init_std: float = 0.02
     resid_pdrop: float = 0.0
     remat: bool = False  # activation checkpointing (reference: fsdp_config.activation_checkpointing)
+    # Pallas flash-attention tile sizes (PERF.md lever 2: block sweep at seq
+    # 2048). Config-tunable so a chip session can sweep without code edits;
+    # ignored by the xla fallback.
+    flash_block_q: int = 256
+    flash_block_k: int = 256
 
     @property
     def d_head(self) -> int:

@@ -98,6 +98,7 @@ class MPTBlock(nn.Module):
         attn_out = multihead_attention(
             q.reshape(shape), k.reshape(shape), v.reshape(shape),
             impl=cfg.attn_impl, causal=True, alibi=cfg.alibi,
+            block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
         )
         attn_out = attn_out.reshape(b, s, cfg.d_model)
         x = x + dense(cfg.d_model, "out_proj", resid_std)(attn_out)

@@ -80,6 +80,8 @@ def multihead_attention(
     impl: str = "pallas",
     causal: bool = True,
     alibi: bool = False,
+    block_q: int | None = None,
+    block_k: int | None = None,
 ) -> jax.Array:
     """Dispatch on ``impl`` ∈ {pallas, xla, ring}. Falls back to XLA off-TPU;
     ``ring`` = context parallelism over the ambient mesh's ``sequence`` axis
@@ -99,10 +101,19 @@ def multihead_attention(
             return ring_attention(q, k, v, mesh, causal=causal, impl=inner, alibi=alibi)
         impl = inner
     if impl == "pallas":
-        from photon_tpu.ops.flash_attention import flash_attention, pallas_supported
+        from photon_tpu.ops.flash_attention import (
+            DEFAULT_BLOCK_K,
+            DEFAULT_BLOCK_Q,
+            flash_attention,
+            pallas_supported,
+        )
 
         if pallas_supported(q):
-            return flash_attention(q, k, v, causal=causal, alibi=alibi)
+            return flash_attention(
+                q, k, v, causal=causal, alibi=alibi,
+                block_q=block_q or DEFAULT_BLOCK_Q,
+                block_k=block_k or DEFAULT_BLOCK_K,
+            )
         impl = "xla"
     if impl != "xla":
         raise ValueError(f"unknown attention impl {impl!r}")
