@@ -46,6 +46,8 @@ PHOTON_BENCH_PLATFORM (skip straight to tpu|cpu),
 PHOTON_BENCH_SKIP_PARITY=1 (skip the kernel parity check),
 PHOTON_BENCH_SECOND_MICRO (pinned-config second microbatch trial after the
 first emit; default 2x the pinned micro, 0 disables),
+PHOTON_BENCH_TRY_BLOCK (flash tile trial after the micro trials; default
+512, 0 disables),
 PHOTON_BENCH_SKIP_SWEEP=1 (skip the microbatch sweep),
 PHOTON_BENCH_PROFILE=1 (write a jax.profiler trace of the timed window).
 """
@@ -571,8 +573,9 @@ def run(platform: str) -> None:
     warm(trainer)
     micro = trainer.device_microbatch_size
 
-    def try_candidate(micro_c: int, n_timed: int, free_current_first: bool):
-        """Build + warm + time a candidate trainer at ``micro_c``. Returns
+    def try_candidate(micro_c: int, n_timed: int, free_current_first: bool, mutate=None):
+        """Build + warm + time a candidate trainer at ``micro_c`` (``mutate``
+        applies further config tweaks, e.g. flash tile sizes). Returns
         ``(trainer, dt, loss)`` or None; frees the candidate's HBM on
         failure. ``free_current_first`` drops the current trainer's state
         before the build (two resident TrainStates double HBM pressure and
@@ -581,6 +584,8 @@ def run(platform: str) -> None:
         cfg_c = Config.from_dict(cfg.to_dict())
         cfg_c.model.attn_impl = cfg.model.attn_impl
         cfg_c.train.device_microbatch_size = micro_c
+        if mutate is not None:
+            mutate(cfg_c)
         t_c = None
         try:
             if free_current_first:
@@ -690,6 +695,37 @@ def run(platform: str) -> None:
                 else:
                     t2.state = None
                     del t2
+
+    # Flash tile trial (PERF.md lever 2): 512x512 blocks halve the number of
+    # grid steps at seq 2048; worth one compile once a result is safe.
+    block = int(os.environ.get("PHOTON_BENCH_TRY_BLOCK", "512"))
+    if on_tpu and block and cfg.model.attn_impl == "pallas" \
+            and block != cfg.model.flash_block_q:
+        def _blocks(c, b=block):
+            c.model.flash_block_q = b
+            c.model.flash_block_k = b
+
+        cand = try_candidate(micro, n_timed=n_steps, free_current_first=True,
+                             mutate=_blocks)
+        if cand is not None:
+            t3, dt3, loss3 = cand
+            tps3 = n_steps * gbs * seq / dt3
+            log(f"block-{block} trial: {tps3:,.0f} tok/s vs {toks_per_sec:,.0f}")
+            if tps3 > toks_per_sec:
+                trainer = t3
+                toks_per_sec, loss = tps3, loss3
+                mfu = toks_per_sec * flops_per_tok / peak
+                out.update({
+                    "value": round(toks_per_sec, 1),
+                    "vs_baseline": round(toks_per_sec / A100_EST_TOKENS_PER_SEC, 4),
+                    "mfu": round(mfu, 4),
+                    "flash_block": block,
+                    "final_loss": round(loss, 3),
+                })
+                emit(out)
+            else:
+                t3.state = None
+                del t3
 
     if on_tpu and os.environ.get("PHOTON_BENCH_SKIP_PARITY") != "1":
         # free the trainer's HBM first — parity allocates its own test tensors
