@@ -1,0 +1,63 @@
+"""Long-context training: seq 4096 through the FULL train step with ring
+attention over the sequence axis.
+
+The brief makes long context first-class (ring / context parallelism for
+long sequences); the reference caps at seq 2048 with single-GPU flash
+attention (SURVEY §5 "Long-context: absent"). The kernel-level ring tests
+stop at seq 64 — this one trains at 2x the reference's maximum length on a
+``sequence=4`` mesh and must reproduce the single-device loss trajectory,
+proving the k/v-rotation (ppermute) path composes with grad accumulation,
+chunked CE, and the optimizer at real length.
+"""
+
+import numpy as np
+import pytest
+
+from photon_tpu.config.schema import (
+    Config,
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    TrainConfig,
+)
+from photon_tpu.train.trainer import Trainer
+
+SEQ = 4096
+
+LONG = ModelConfig(
+    d_model=32, n_layers=1, n_heads=2, max_seq_len=SEQ, vocab_size=128,
+    attn_impl="xla", compute_dtype="float32",
+)
+
+
+def _cfg(mesh: MeshConfig, attn: str) -> Config:
+    model = ModelConfig(**{**LONG.__dict__, "attn_impl": attn})
+    return Config(
+        model=model,
+        mesh=mesh,
+        optimizer=OptimizerConfig(name="adamw", lr=1e-3),
+        scheduler=SchedulerConfig(t_warmup=2, t_max=100),
+        train=TrainConfig(global_batch_size=2, device_microbatch_size=2),
+    )
+
+
+@pytest.mark.slow
+def test_seq4096_ring_training_matches_single_device():
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, LONG.vocab_size, (2, SEQ), dtype=np.int64)
+
+    def run(mesh: MeshConfig, attn: str) -> list[float]:
+        t = Trainer(_cfg(mesh, attn), init_seed=0)
+        losses = []
+        for _ in range(3):
+            m = t.fit([tokens], duration_steps=1)
+            losses.append(m["loss"])
+        return losses
+
+    ref = run(MeshConfig(), "xla")  # single device, full attention
+    ring = run(MeshConfig(sequence=4), "ring")  # 4-way context parallel
+    np.testing.assert_allclose(ring, ref, rtol=2e-4, atol=2e-5)
+    # warmup lr is 0 at the first step (losses[0] == losses[1] by design);
+    # by the third the repeated batch must be learned a little
+    assert ref[2] < ref[0]
