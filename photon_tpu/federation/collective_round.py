@@ -105,6 +105,15 @@ class CollectiveFedRunner:
         from photon_tpu.models.mpt import init_params
 
         self.meta, initial = params_to_ndarrays(init_params(cfg.model, seed=cfg.seed))
+        if cfg.fl.aggregate_momenta:
+            # payloads become [params|m1|m2] exactly as in the driver
+            # topology (ServerApp init): clients key off has_momenta(meta),
+            # the psum averages the momenta sections like any other arrays,
+            # and apply_average's length check keeps the replicas honest
+            from photon_tpu.train.param_ops import extend_with_momenta, has_momenta
+
+            if not has_momenta(self.meta):
+                self.meta, initial = extend_with_momenta(self.meta, initial)
         self.strategy.initialize(initial)
         self.history = History()
         self.server_steps_cumulative = 0
