@@ -115,6 +115,8 @@ def _tuned_env() -> dict:
         env["PHOTON_BENCH_GBS"] = str(cfg["gbs"])
     if cfg.get("remat"):
         env["PHOTON_BENCH_REMAT"] = "1"
+    if cfg.get("flash_block"):
+        env["PHOTON_BENCH_FLASH_BLOCK"] = str(cfg["flash_block"])
     return env
 
 
@@ -542,6 +544,10 @@ def run(platform: str) -> None:
     cfg = Config()
     cfg.model.attn_impl = "pallas" if on_tpu else "xla"
     cfg.model.remat = os.environ.get("PHOTON_BENCH_REMAT") == "1"
+    tuned_block = int(os.environ.get("PHOTON_BENCH_FLASH_BLOCK", "0"))
+    if tuned_block:
+        cfg.model.flash_block_q = tuned_block
+        cfg.model.flash_block_k = tuned_block
     if not on_tpu:  # smoke-scale fallback so the bench also runs on CPU
         cfg.model.n_layers = 2
         cfg.model.max_seq_len = 256
@@ -653,6 +659,7 @@ def run(platform: str) -> None:
         "microbatch": micro,
         "global_batch": gbs,
         "remat": cfg.model.remat,
+        "flash_block": cfg.model.flash_block_q,
         "loss_chunk_tokens": cfg.train.loss_chunk_tokens,
         "final_loss": round(loss, 3),
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),

@@ -1,8 +1,13 @@
 """Interactive TPU probe: find the fastest (remat, microbatch) config for the
 125M recipe on the attached chip. Not part of the bench; a tuning tool.
 
-Usage: python scripts/tpu_probe.py 'remat,micro,gbs,steps[,impl]' ...
-e.g.   python scripts/tpu_probe.py 1,4,16,8 1,8,16,8 0,4,16,8,xla
+Usage: python scripts/tpu_probe.py 'remat,micro,gbs,steps[,impl[,block]]' ...
+e.g.   python scripts/tpu_probe.py 1,4,16,8 1,8,16,8 0,4,16,8,xla 0,4,256,6,pallas,512
+
+Or one-shot ladder tuning that writes the winner into bench_tuned.json
+(what the driver's bench pins on its first TPU attempt):
+
+    python scripts/tpu_probe.py --auto [gbs]    # default gbs 256
 """
 
 from __future__ import annotations
@@ -27,7 +32,8 @@ def log(msg: str) -> None:
     print(f"[probe] {msg}", file=sys.stderr, flush=True)
 
 
-def probe(remat: bool, micro: int, gbs: int, steps: int, impl: str = "pallas") -> dict:
+def probe(remat: bool, micro: int, gbs: int, steps: int, impl: str = "pallas",
+          block: int = 0) -> dict:
     import numpy as np
 
     from photon_tpu.config.schema import Config
@@ -38,6 +44,9 @@ def probe(remat: bool, micro: int, gbs: int, steps: int, impl: str = "pallas") -
     cfg = Config()
     cfg.model.attn_impl = impl
     cfg.model.remat = remat
+    if block:
+        cfg.model.flash_block_q = block
+        cfg.model.flash_block_k = block
     cfg.train.device_microbatch_size = micro
     cfg.train.global_batch_size = gbs
     cfg.validate()
@@ -72,23 +81,56 @@ def probe(remat: bool, micro: int, gbs: int, steps: int, impl: str = "pallas") -
     del trainer
     return {
         "remat": remat, "micro": micro, "gbs": gbs, "steps": steps, "impl": impl,
-        "compile_s": round(compile_s, 1), "tokens_per_sec": round(toks, 1),
+        "block": block or None, "compile_s": round(compile_s, 1), "tokens_per_sec": round(toks, 1),
         "mfu": round(mfu, 4), "loss": round(loss, 3),
         "step_ms": round(1000 * dt / steps, 1),
     }
 
 
+def auto(gbs: int) -> None:
+    """Sweep the PERF.md ladder (micro x flash tile, remat off — the 125M
+    recipe keeps it off) and pin the winner in bench_tuned.json."""
+    results = []
+    for micro in (2, 4, 8):
+        for block in (256, 512):
+            log(f"--- auto micro={micro} block={block} gbs={gbs}")
+            try:
+                results.append(probe(False, micro, gbs, steps=4, block=block))
+                log(f"    -> {results[-1]}")
+            except Exception as e:  # noqa: BLE001 — keep sweeping on OOM
+                log(f"    -> FAILED: {str(e).splitlines()[0][:160]}")
+    ok = [r for r in results if "tokens_per_sec" in r]
+    if not ok:
+        log("auto: every config failed; bench_tuned.json left untouched")
+        print(json.dumps(results, indent=2), flush=True)
+        return
+    best = max(ok, key=lambda r: r["tokens_per_sec"])
+    tuned = {
+        "microbatch": best["micro"], "gbs": gbs, "remat": False,
+        "flash_block": best["block"],
+        "source": f"tpu_probe --auto: {best['tokens_per_sec']:,.0f} tok/s "
+                  f"(mfu {best['mfu']}) at micro {best['micro']} block {best['block']}",
+    }
+    (HERE / "bench_tuned.json").write_text(json.dumps(tuned))
+    log(f"wrote bench_tuned.json: {tuned}")
+    print(json.dumps({"results": results, "tuned": tuned}, indent=2), flush=True)
+
+
 def main() -> None:
     dev = jax.devices()[0]
     log(f"device: {dev} kind={dev.device_kind}")
+    if sys.argv[1:] and sys.argv[1] == "--auto":
+        auto(int(sys.argv[2]) if len(sys.argv) > 2 else 256)
+        return
     results = []
     for spec in sys.argv[1:]:
         parts = spec.split(",")
         remat, micro, gbs, steps = (int(x) for x in parts[:4])
         impl = parts[4] if len(parts) > 4 else "pallas"
-        log(f"--- config remat={bool(remat)} micro={micro} gbs={gbs} steps={steps} impl={impl}")
+        block = int(parts[5]) if len(parts) > 5 else 0
+        log(f"--- config remat={bool(remat)} micro={micro} gbs={gbs} steps={steps} impl={impl} block={block}")
         try:
-            r = probe(bool(remat), micro, gbs, steps, impl)
+            r = probe(bool(remat), micro, gbs, steps, impl, block)
             log(f"    -> {r}")
             results.append(r)
         except Exception as e:  # noqa: BLE001 - report every config
