@@ -243,10 +243,61 @@ class CollectiveFedRunner:
         self.history.record(server_round, metrics)
         return metrics
 
+    def evaluate_round(self, server_round: int) -> dict[str, float]:
+        """Fed eval over the collective: every controller scores its clients
+        on the post-aggregation replica params, then the sample-weighted
+        loss rides the same psum machinery as the fit averages (reference:
+        ``evaluate_round`` → ``aggregate_evaluate``,
+        ``server/evaluate_utils.py:33-158``)."""
+        from photon_tpu.federation.messages import EvaluateIns
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ptr = self.transport.put(
+            f"collective-eval-r{server_round}", self.meta, self.strategy.current_parameters
+        )
+        self.runtime.set_broadcast_params(ptr)
+        losses: list[np.ndarray] = []
+        ns: list[int] = []
+        for cid in self.process_cids:
+            ins = EvaluateIns(
+                server_round=server_round, cids=[cid], params=None,
+                config=dict(self.cfg.fl.eval_config),
+            )
+            res = self.runtime.evaluate(ins, cid)
+            if res.error:
+                raise RuntimeError(
+                    f"collective eval round {server_round}: cid {cid} failed: {res.error}"
+                )
+            losses.append(np.asarray([res.loss], np.float32))
+            ns.append(res.n_samples)
+        loss_global = self._stack_local([[l] for l in losses])[0]
+        ns_global = jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, P(CLIENT_AXIS)),
+            np.asarray(ns, np.int32),
+            (self.cfg.fl.n_total_clients,),
+        )
+        avg, total = collective_weighted_average(
+            [loss_global], ns_global, self.mesh, return_total=True
+        )
+        metrics = {
+            "server/eval_loss": float(np.asarray(avg[0])[0]),
+            "server/eval_samples": float(np.asarray(total)),
+        }
+        self.history.record(server_round, metrics)
+        return metrics
+
     def run(self, n_rounds: int | None = None) -> History:
         n_rounds = n_rounds if n_rounds is not None else self.cfg.fl.n_rounds
+        every = self.cfg.fl.eval_interval_rounds
+        if every:
+            # round-0 baseline on the initial parameters — the driver
+            # topology records it (server.py run()) and eval-curve parity
+            # across planes needs the same starting point
+            self.evaluate_round(0)
         for rnd in range(1, n_rounds + 1):
             self.run_round(rnd)
+            if every and rnd % every == 0:
+                self.evaluate_round(rnd)
         return self.history
 
 

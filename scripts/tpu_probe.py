@@ -99,6 +99,8 @@ def auto(gbs: int) -> None:
                 log(f"    -> {results[-1]}")
             except Exception as e:  # noqa: BLE001 — keep sweeping on OOM
                 log(f"    -> FAILED: {str(e).splitlines()[0][:160]}")
+                results.append({"micro": micro, "block": block, "gbs": gbs,
+                                "error": str(e).splitlines()[0][:200]})
     ok = [r for r in results if "tokens_per_sec" in r]
     if not ok:
         log("auto: every config failed; bench_tuned.json left untouched")

@@ -39,11 +39,13 @@ cids = partition_cids(cfg.fl.n_total_clients, 2, pid)
 runner = CollectiveFedRunner(cfg, cids)
 history = runner.run()
 np.savez(out_path, *runner.strategy.current_parameters)
-print(json.dumps({
-    "pid": pid, "cids": cids,
-    "steps": runner.server_steps_cumulative,
-    "pseudo_grad_norm": history.latest("server/pseudo_grad_norm"),
-}), flush=True)
+with open(out_path + ".metrics.json", "w") as f:
+    json.dump({
+        "steps": runner.server_steps_cumulative,
+        "eval_loss": history.latest("server/eval_loss"),
+        "pseudo_grad_norm": history.latest("server/pseudo_grad_norm"),
+    }, f)
+print(json.dumps({"pid": pid, "cids": cids}), flush=True)
 """
 
 
@@ -62,6 +64,7 @@ def _cfg(tmp_path, strategy="fedavg", momenta=False) -> Config:
     cfg.fl.n_clients_per_round = 4  # collective mode = full participation
     cfg.fl.n_rounds = 2
     cfg.fl.local_steps = 2
+    cfg.fl.eval_interval_rounds = 2
     cfg.fl.strategy_name = strategy
     cfg.fl.server_learning_rate = 1.0 if strategy == "fedavg" else 0.01
     cfg.fl.aggregate_momenta = momenta
@@ -99,8 +102,9 @@ def test_collective_rounds_match_driver_topology(tmp_path, strategy, momenta):
     oracle_cfg.photon.save_path = str(tmp_path / "oracle")
     oracle_cfg.validate()
     app = build_app(oracle_cfg, n_nodes=1)
-    app.run()
+    oracle_hist = app.run()
     oracle_params = app.strategy.current_parameters
+    oracle_eval = oracle_hist.latest("server/eval_loss")
     app.driver.shutdown()
 
     # ---- collective: two real processes, two clients each ----------------
@@ -148,3 +152,8 @@ def test_collective_rounds_match_driver_topology(tmp_path, strategy, momenta):
     with np.load(outs[0]) as z0, np.load(outs[1]) as z1:
         for k in z0.files:
             np.testing.assert_array_equal(z0[k], z1[k])
+    # fed eval over the collective matches the driver topology's eval
+    for out in outs:
+        m = json.loads(pathlib.Path(str(out) + ".metrics.json").read_text())
+        assert m["eval_loss"] is not None and oracle_eval is not None
+        np.testing.assert_allclose(m["eval_loss"], oracle_eval, rtol=1e-3)
