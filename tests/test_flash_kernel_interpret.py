@@ -104,3 +104,32 @@ def test_alibi_long_range_decay():
     # must actually differ (bias applied), and both be finite
     assert _rel(o_alibi, o_plain) > 1e-3
     assert np.isfinite(np.asarray(o_alibi)).all()
+
+
+@pytest.mark.parametrize("block", [256, 512])
+def test_large_tile_parity(block):
+    """The 512-tile configuration the bench's block trial runs on hardware
+    (PERF.md lever 2, ``PHOTON_BENCH_TRY_BLOCK``) must be numerically
+    correct BEFORE its first on-chip execution — fwd + bwd at a sequence
+    long enough (1024) that multiple 512 tiles and the causal off-diagonal
+    both exercise."""
+    q, k, v = _qkv(s=1024, seed=7)
+    o_k = flash_attention(q, k, v, causal=True, block_q=block, block_k=block,
+                          interpret=True)
+    o_x = xla_attention(q, k, v, causal=True)
+    assert _rel(o_k, o_x) < 2e-5, block
+
+    w = jax.random.normal(jax.random.PRNGKey(8), o_x.shape)
+
+    def loss(fn):
+        return jax.grad(
+            lambda q, k, v: (fn(q, k, v).astype(jnp.float32) * w).sum(),
+            argnums=(0, 1, 2),
+        )
+
+    gk = loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=block, block_k=block, interpret=True
+    ))(q, k, v)
+    gx = loss(lambda q, k, v: xla_attention(q, k, v, causal=True))(q, k, v)
+    for a, ref in zip(gk, gx):
+        assert _rel(a, ref) < 2e-4, block
