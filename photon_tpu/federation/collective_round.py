@@ -66,7 +66,13 @@ def partition_cids(n_total_clients: int, num_processes: int, process_id: int) ->
 
 class CollectiveFedRunner:
     """Multi-controller federated loop: local fits → psum average → replica
-    strategy update, every round, on every process."""
+    strategy update, every round, on every process.
+
+    Launch assumption: ONE chip per process (the standard TPU multi-controller
+    shape). The client trainer is pinned to ``jax.local_devices()[0]``; on a
+    multi-chip-per-process slice the extra local chips would only hold psum
+    rows while fits run serially on chip 0 — launch one process per chip
+    instead (e.g. ``--num_processes == slice chip count``)."""
 
     def __init__(self, cfg: Config, process_cids: Sequence[int], mesh=None) -> None:
         if not cfg.photon.comm_stack.collective:

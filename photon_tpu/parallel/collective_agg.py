@@ -64,7 +64,15 @@ def collective_weighted_average(
     """
 
     def local(ns, *leaves):
-        # ns: [n_local] local sample counts; leaves: [n_local, ...] rows
+        # ns: [n_local] local sample counts; leaves: [n_local, ...] rows.
+        # make_client_mesh pins exactly one client per device; the numerator
+        # below reads only row 0, so a mesh packing >1 row per shard would
+        # drop clients while still counting their samples — fail loudly.
+        if ns.shape[0] != 1:
+            raise ValueError(
+                f"collective aggregation expects 1 client row per device "
+                f"shard, got {ns.shape[0]} — repack the client mesh"
+            )
         n_total = jax.lax.psum(jnp.sum(ns.astype(jnp.float32)), CLIENT_AXIS)
         w = ns[0].astype(jnp.float32) / n_total
         outs = tuple(

@@ -148,7 +148,7 @@ aggregated with FedAvg(lr=1.0), the centralized run GBS
 
 {chr(10).join(lines)}
 
-Final-token gap (fed − central): **{gap:+.4f} nats** — {"within" if gap is not None and abs(gap) < 0.1 else "outside"} the ≈0.1-nat
+Final-token gap (fed − central): **{f"{gap:+.4f} nats" if gap is not None else "n/a (missing eval series)"}** — {"n/a for" if gap is None else "within" if abs(gap) < 0.1 else "outside"} the ≈0.1-nat
 band expected from FedAvg's averaging penalty at this scale.
 
 Wall clock: centralized {central["wall_s"]}s, federated {fed["wall_s"]}s
@@ -160,8 +160,11 @@ byte-fallback --seq-len 256 --n-clients 8` (train + val splits) →
 `python scripts/convergence_run.py --data /tmp/pts256`.
 """
     (out_dir / "CONVERGENCE.md").write_text(report)
-    print(json.dumps({"gap": gap, "central_final": central["eval_loss"][-1],
-                      "fed_final": fed["eval_loss"][-1]}))
+    print(json.dumps({
+        "gap": gap,
+        "central_final": central["eval_loss"][-1] if central["eval_loss"] else None,
+        "fed_final": fed["eval_loss"][-1] if fed["eval_loss"] else None,
+    }))
 
 
 def main(argv=None):
