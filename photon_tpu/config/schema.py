@@ -55,6 +55,15 @@ class ModelConfig:
     # positions, but the family supports both)
     alibi: bool = False
     tie_embeddings: bool = True
+    # Llama-family knobs (beyond the reference's MPT configs, which
+    # llm-foundry exposes as attn_config/ffn_config variants): RoPE
+    # positions, RMSNorm, SwiGLU MLP — composable rather than a separate
+    # model class, so every trainer/sharding/federation path is shared.
+    rope: bool = False  # rotary positions (excludes alibi/learned_pos_emb)
+    rope_theta: float = 10000.0
+    norm: str = "layernorm"  # layernorm | rmsnorm (both fp32)
+    mlp: str = "gelu"  # gelu | swiglu (fused gate+up projection)
+    mlp_hidden_size: int = 0  # 0 -> expansion_ratio * d_model
     attn_impl: str = AttnImpl.PALLAS.value
     # Numerics: params kept fp32, compute in bf16 (reference: amp_bf16 + FSDP
     # PURE mixed precision, ``mpt-125m.yaml:85-92``).
@@ -307,6 +316,14 @@ class Config:
             raise ValueError("resid_pdrop > 0 is not implemented yet (dropout-free pretraining)")
         if self.model.alibi and self.model.learned_pos_emb:
             raise ValueError("alibi and learned_pos_emb are mutually exclusive")
+        if self.model.rope and (self.model.alibi or self.model.learned_pos_emb):
+            raise ValueError("rope excludes alibi and learned_pos_emb")
+        if self.model.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"bad model.norm {self.model.norm}")
+        if self.model.mlp not in ("gelu", "swiglu"):
+            raise ValueError(f"bad model.mlp {self.model.mlp}")
+        if self.model.rope and self.model.d_head % 2:
+            raise ValueError("rope needs an even d_head")
         _ = self.model.d_head
         return self
 

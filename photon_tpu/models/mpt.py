@@ -1,9 +1,15 @@
-"""MPT-style decoder-only language model, TPU-first in flax.linen.
+"""Decoder-only language model family, TPU-first in flax.linen.
 
 Behavioral parity target: llm-foundry's ``mpt_causal_lm`` as configured by the
 reference (``conf/llm_config/mpt-125m.yaml:18-28``): learned positional
 embeddings, pre-LayerNorm blocks, fused-QKV attention, 4x GELU MLP, no biases
 (MPT ``no_bias``), tied input/output embeddings, vocab 50368.
+
+Llama-family variants compose through ``ModelConfig`` knobs rather than a
+second model class (``rope``/``norm: rmsnorm``/``mlp: swiglu``/untied
+embeddings — preset ``llama-1b``), the shape of llm-foundry's
+attn_config/ffn_config switches; every trainer, sharding, checkpoint, and
+federation path is shared because the parameter tree keeps the same names.
 
 TPU-first design choices (not in the reference):
 - Layers are stacked with ``nn.scan`` → one traced block, params carry a
@@ -72,6 +78,48 @@ class FP32LayerNorm(nn.Module):
         return y.astype(orig_dtype)
 
 
+class FP32RMSNorm(nn.Module):
+    """RMSNorm in fp32 (llama-family norm; scale-only by construction)."""
+
+    eps: float = 1.0e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x32 = x.astype(jnp.float32)
+        y = x32 * jax.lax.rsqrt(
+            jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + self.eps
+        )
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        return (y * scale).astype(x.dtype)
+
+
+def _norm(cfg: ModelConfig, name: str) -> nn.Module:
+    if cfg.norm == "rmsnorm":
+        return FP32RMSNorm(name=name)
+    return FP32LayerNorm(use_bias=not cfg.no_bias, name=name)
+
+
+def apply_rope(q: jax.Array, k: jax.Array, theta: float) -> tuple[jax.Array, jax.Array]:
+    """Rotary positions on ``[B, S, H, D]`` q/k (llama/GPT-NeoX rotate-half
+    convention, angles in fp32). Positions are LOGICAL sequence indices, so
+    the rotation is correct under a GSPMD-sharded ``sequence`` mesh axis —
+    ring attention receives already-rotated q/k and needs no offset."""
+    d = q.shape[-1]
+    half = d // 2
+    inv = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    ang = jnp.arange(q.shape[1], dtype=jnp.float32)[:, None] * inv[None, :]
+    cos = jnp.cos(ang)[None, :, None, :]  # [1, S, 1, half]
+    sin = jnp.sin(ang)[None, :, None, :]
+
+    def rot(x):
+        x1 = x[..., :half].astype(jnp.float32)
+        x2 = x[..., half:].astype(jnp.float32)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        return out.astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
 class MPTBlock(nn.Module):
     cfg: ModelConfig
 
@@ -90,13 +138,16 @@ class MPTBlock(nn.Module):
         resid_std = cfg.emb_init_std / (2.0 * cfg.n_layers) ** 0.5
 
         # --- attention ---
-        h = FP32LayerNorm(use_bias=not cfg.no_bias, name="ln_1")(x)
+        h = _norm(cfg, "ln_1")(x)
         qkv = dense(3 * cfg.d_model, "wqkv", cfg.emb_init_std)(h)
         b, s, _ = qkv.shape
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (b, s, cfg.n_heads, cfg.d_head)
+        q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        if cfg.rope:
+            q, k = apply_rope(q, k, cfg.rope_theta)
         attn_out = multihead_attention(
-            q.reshape(shape), k.reshape(shape), v.reshape(shape),
+            q, k, v,
             impl=cfg.attn_impl, causal=True, alibi=cfg.alibi,
             block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
         )
@@ -104,9 +155,20 @@ class MPTBlock(nn.Module):
         x = x + dense(cfg.d_model, "out_proj", resid_std)(attn_out)
 
         # --- MLP ---
-        h = FP32LayerNorm(use_bias=not cfg.no_bias, name="ln_2")(x)
-        h = dense(cfg.expansion_ratio * cfg.d_model, "up_proj", cfg.emb_init_std)(h)
-        h = nn.gelu(h, approximate=True)
+        h = _norm(cfg, "ln_2")(x)
+        hidden = cfg.mlp_hidden_size or cfg.expansion_ratio * cfg.d_model
+        if cfg.mlp == "swiglu":
+            # separate gate/up projections (standard llama layout): each is
+            # column-parallel under the same sharding rule, so silu(gate)*up
+            # is shard-local — a fused gate||up matrix would put ALL of gate
+            # on the first half of the tensor group and force a per-layer
+            # resharding collective
+            gate = dense(hidden, "gate_proj", cfg.emb_init_std)(h)
+            up = dense(hidden, "up_proj", cfg.emb_init_std)(h)
+            h = nn.silu(gate) * up
+        else:
+            h = dense(hidden, "up_proj", cfg.emb_init_std)(h)
+            h = nn.gelu(h, approximate=True)
         x = x + dense(cfg.d_model, "down_proj", resid_std)(h)
         return x
 
@@ -147,8 +209,8 @@ class MPTModel(nn.Module):
             name="wte",
         )
         x = wte(tokens)
-        # with ALiBi the position signal lives in the attention bias; no wpe
-        if cfg.learned_pos_emb and not cfg.alibi:
+        # with ALiBi/RoPE the position signal lives in attention; no wpe
+        if cfg.learned_pos_emb and not cfg.alibi and not cfg.rope:
             wpe = self.param(
                 "wpe",
                 nn.initializers.normal(stddev=cfg.emb_init_std),
@@ -174,7 +236,7 @@ class MPTModel(nn.Module):
         )(cfg, name="blocks")
         x, _ = stack(x, None)
 
-        x = FP32LayerNorm(use_bias=not cfg.no_bias, name="ln_f")(x)
+        x = _norm(cfg, "ln_f")(x)
         if return_hidden:
             return x
         if cfg.tie_embeddings:
@@ -182,7 +244,9 @@ class MPTModel(nn.Module):
         else:
             logits = nn.Dense(
                 cfg.vocab_size, use_bias=False, dtype=compute,
-                param_dtype=_dtype(cfg.param_dtype), name="lm_head",
+                param_dtype=_dtype(cfg.param_dtype),
+                kernel_init=nn.initializers.normal(stddev=cfg.emb_init_std),
+                name="lm_head",
             )(x)
         logits = _constrain_logits(logits)
         return logits.astype(_dtype(cfg.logits_dtype))

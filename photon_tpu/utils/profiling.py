@@ -108,9 +108,14 @@ class Timer:
 
 def model_flops_per_token(cfg: ModelConfig) -> float:
     """Training FLOPs/token ≈ 6·N_nonemb + 12·L·d·s (attention) + 6·d·V
-    (tied lm_head). Matches the estimate used for BASELINE vs_baseline."""
+    (lm_head, tied or not). Matches the estimate used for BASELINE
+    vs_baseline; honors the llama-family knobs (``mlp_hidden_size``
+    override, SwiGLU's third projection)."""
     d, L, s, v = cfg.d_model, cfg.n_layers, cfg.max_seq_len, cfg.vocab_size
-    n_block = L * (4 * d * d + 2 * d * cfg.expansion_ratio * d)  # qkv+proj / mlp
+    hidden = cfg.mlp_hidden_size or cfg.expansion_ratio * d
+    # gelu: up+down = 2·d·F weights; swiglu adds the gate = 3·d·F
+    mlp_w = (3 if cfg.mlp == "swiglu" else 2) * d * hidden
+    n_block = L * (4 * d * d + mlp_w)  # qkv+out_proj / mlp
     attn = 12 * L * d * s  # score + value matmuls, fwd+bwd
     head = 6 * d * v
     return 6.0 * n_block + attn + head

@@ -1,0 +1,224 @@
+"""Llama-family variants (RoPE + RMSNorm + SwiGLU + untied embeddings)
+compose through the shared model/trainer/sharding stack.
+
+The family knobs replace llm-foundry's attn_config/ffn_config switches
+(reference ships only MPT configs, so this is beyond-reference surface);
+the tests pin the three properties that make the variant correct rather
+than merely runnable: RoPE's relative-position invariance, SwiGLU/RMSNorm
+forward behavior, and the sharding rules still matching the (fused) llama
+parameter tree on a tensor/fsdp mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.config import load_preset
+from photon_tpu.config.schema import Config
+
+
+def _llama_tiny() -> Config:
+    cfg = Config()
+    cfg.model.d_model = 64
+    cfg.model.n_layers = 2
+    cfg.model.n_heads = 4
+    cfg.model.max_seq_len = 32
+    cfg.model.vocab_size = 128
+    cfg.model.attn_impl = "xla"
+    cfg.model.compute_dtype = "float32"
+    cfg.model.rope = True
+    cfg.model.learned_pos_emb = False
+    cfg.model.norm = "rmsnorm"
+    cfg.model.mlp = "swiglu"
+    cfg.model.tie_embeddings = False
+    cfg.train.global_batch_size = 4
+    cfg.train.device_microbatch_size = 4
+    return cfg.validate()
+
+
+def test_rope_relative_position_invariance():
+    """Attention scores q_i . k_j after RoPE depend only on i - j: rotating
+    the same q/k content placed at shifted positions must give identical
+    relative scores — the property RoPE exists to provide."""
+    from photon_tpu.models.mpt import apply_rope
+
+    rng = np.random.default_rng(0)
+    d = 16
+    q1 = jnp.asarray(rng.normal(size=(1, 8, 1, d)), jnp.float32)
+    k1 = jnp.asarray(rng.normal(size=(1, 8, 1, d)), jnp.float32)
+    # same content shifted by 3 positions (pad the front; content at 3..7)
+    shift = 3
+    q2 = jnp.pad(q1, ((0, 0), (shift, 0), (0, 0), (0, 0)))[:, :8]
+    k2 = jnp.pad(k1, ((0, 0), (shift, 0), (0, 0), (0, 0)))[:, :8]
+
+    rq1, rk1 = apply_rope(q1, k1, 10000.0)
+    rq2, rk2 = apply_rope(q2, k2, 10000.0)
+
+    def score(q, k, i, j):
+        return float(jnp.dot(q[0, i, 0], k[0, j, 0]))
+
+    # pairs (i, j) and (i+shift, j+shift) address the same content rows
+    for i, j in [(2, 0), (4, 1), (3, 3)]:
+        np.testing.assert_allclose(
+            score(rq1, rk1, i, j),
+            score(rq2, rk2, i + shift, j + shift),
+            rtol=1e-5,
+        )
+    # and the rotation is NOT position-independent (sanity)
+    assert abs(score(rq1, rk1, 2, 0) - score(q1, k1, 2, 0)) > 1e-6
+
+
+def test_rope_zero_position_identity():
+    from photon_tpu.models.mpt import apply_rope
+
+    q = jnp.asarray(np.random.default_rng(1).normal(size=(2, 1, 2, 8)), jnp.float32)
+    rq, rk = apply_rope(q, q, 10000.0)
+    # position 0 rotates by angle 0 -> identity
+    np.testing.assert_allclose(np.asarray(rq), np.asarray(q), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(rk), np.asarray(q), rtol=1e-6)
+
+
+def test_llama_variant_trains_and_param_tree():
+    """End-to-end: init -> 8 train steps on a repeated batch -> loss falls;
+    the parameter tree keeps the shared names (sharding/checkpoint/psum
+    compatibility) with the fused SwiGLU projection and no wpe."""
+    from photon_tpu.models.mpt import MPTModel, init_params
+    from photon_tpu.optim import build_optimizer
+    from photon_tpu.train.train_step import init_train_state, make_train_step
+
+    cfg = _llama_tiny()
+    model = MPTModel(cfg.model)
+    params = init_params(cfg.model, seed=0)
+
+    assert "wpe" not in params, "rope model must not allocate wpe"
+    assert "lm_head" in params, "untied embeddings need a head"
+    blocks = params["blocks"]["block"]
+    # separate gate/up projections (shard-local silu(gate)*up): [L, D, F]
+    hidden = cfg.model.mlp_hidden_size or cfg.model.expansion_ratio * cfg.model.d_model
+    assert blocks["gate_proj"]["kernel"].shape == (2, 64, hidden)
+    assert blocks["up_proj"]["kernel"].shape == (2, 64, hidden)
+    # rmsnorm is scale-only
+    assert set(blocks["ln_1"].keys()) == {"scale"}
+
+    tx, _ = build_optimizer(cfg.optimizer, cfg.scheduler)
+    state = init_train_state(model, tx, params)
+    step = jax.jit(make_train_step(model, tx, n_microbatches=1,
+                                   loss_chunk_tokens=64), donate_argnums=0)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(0), (4, 32), 0, cfg.model.vocab_size
+    )
+    losses = []
+    for _ in range(8):
+        state, m = step(state, tokens)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_llama_sharded_train_step_runs():
+    """The same sharding rules place the llama tree on a tensor2 x fsdp2
+    mesh and a full sharded train step executes (hidden 2F divisible)."""
+    from photon_tpu.config.schema import MeshConfig
+    from photon_tpu.parallel.mesh import make_mesh
+    from photon_tpu.train.trainer import Trainer
+
+    cfg = _llama_tiny()
+    cfg.mesh = MeshConfig(fsdp=2, tensor=2)
+    cfg.train.global_batch_size = 4
+    cfg.train.device_microbatch_size = 2
+    cfg.validate()
+    trainer = Trainer(cfg, mesh=make_mesh(cfg.mesh))
+    batch = np.random.default_rng(0).integers(
+        0, cfg.model.vocab_size, (4, 32), dtype=np.int32
+    )
+    metrics = trainer.fit([batch], duration_steps=1)
+    assert np.isfinite(metrics["loss"])
+
+
+def test_llama_rope_ring_matches_single_device():
+    """RoPE under the sequence mesh axis (ring attention): positions are
+    logical indices, so the seq-sharded loss must equal the single-device
+    loss — the invariant apply_rope's docstring claims."""
+    from photon_tpu.config.schema import MeshConfig
+    from photon_tpu.parallel.mesh import make_mesh
+    from photon_tpu.train.trainer import Trainer
+
+    batch = np.random.default_rng(2).integers(0, 128, (2, 32), dtype=np.int32)
+
+    def loss_for(mesh_cfg, impl):
+        cfg = _llama_tiny()
+        cfg.mesh = mesh_cfg
+        cfg.model.attn_impl = impl
+        cfg.train.global_batch_size = 2
+        cfg.train.device_microbatch_size = 2
+        cfg.validate()
+        trainer = Trainer(cfg, mesh=make_mesh(cfg.mesh))
+        return trainer.fit([batch.copy()], duration_steps=1)["loss"]
+
+    single = loss_for(MeshConfig(), "xla")
+    ring = loss_for(MeshConfig(sequence=2), "ring")
+    np.testing.assert_allclose(ring, single, rtol=2e-5)
+
+
+def test_llama_sharding_specs_mlp_projections():
+    from photon_tpu.config.schema import MeshConfig
+    from photon_tpu.models.mpt import init_params
+    from photon_tpu.parallel.mesh import make_mesh
+    from photon_tpu.parallel.sharding import param_specs
+
+    cfg = _llama_tiny()
+    params = init_params(cfg.model, seed=0)
+    mesh = make_mesh(MeshConfig(fsdp=2, tensor=2))
+    specs = param_specs(params, mesh)
+    up = specs["blocks"]["block"]["up_proj"]["kernel"]
+    gate = specs["blocks"]["block"]["gate_proj"]["kernel"]
+    down = specs["blocks"]["block"]["down_proj"]["kernel"]
+    assert up == jax.sharding.PartitionSpec(None, "fsdp", "tensor")
+    assert gate == jax.sharding.PartitionSpec(None, "fsdp", "tensor")
+    assert down == jax.sharding.PartitionSpec(None, "tensor", "fsdp")
+    assert specs["lm_head"]["kernel"] == jax.sharding.PartitionSpec("tensor", "fsdp")
+
+
+def test_flops_formula_honors_family_knobs():
+    """MFU/vs_baseline math must count the llama MLP correctly: SwiGLU has
+    three d x F projections and mlp_hidden_size overrides expansion_ratio."""
+    from photon_tpu.utils.profiling import model_flops_per_token
+
+    cfg = _llama_tiny()
+    d, L, F = cfg.model.d_model, cfg.model.n_layers, 4 * cfg.model.d_model
+    base = model_flops_per_token(cfg.model)
+    cfg.model.mlp = "gelu"
+    gelu = model_flops_per_token(cfg.model)
+    assert base - gelu == 6 * L * d * F  # the gate projection's 6·d·F
+    cfg.model.mlp_hidden_size = 2 * F
+    assert model_flops_per_token(cfg.model) - gelu == 6 * L * 2 * d * F
+
+
+def test_llama_1b_preset_loads_and_counts():
+    cfg = load_preset("llama-1b")
+    cfg.validate()
+    assert cfg.model.rope and cfg.model.norm == "rmsnorm" and cfg.model.mlp == "swiglu"
+    # parameter count from shapes alone (no materialization): ~1.26B — the
+    # TinyLlama dims with full MHA (no GQA) instead of 4 kv heads
+    d, L, F, V = (cfg.model.d_model, cfg.model.n_layers,
+                  cfg.model.mlp_hidden_size, cfg.model.vocab_size)
+    n = V * d * 2 + L * (4 * d * d + 3 * d * F) + (2 * L + 1) * d
+    assert 1.2e9 < n < 1.35e9, f"{n:,}"
+
+
+@pytest.mark.parametrize("bad", [
+    dict(rope=True, alibi=True),
+    dict(rope=True, learned_pos_emb=True),
+    dict(norm="batchnorm"),
+    dict(mlp="moe"),
+])
+def test_family_knob_validation(bad):
+    cfg = _llama_tiny()
+    cfg.model.rope = False
+    cfg.model.alibi = False
+    cfg.model.learned_pos_emb = False
+    for k, v in bad.items():
+        setattr(cfg.model, k, v)
+    with pytest.raises(ValueError):
+        cfg.validate()
