@@ -61,6 +61,7 @@ class ModelConfig:
     # model class, so every trainer/sharding/federation path is shared.
     rope: bool = False  # rotary positions (excludes alibi/learned_pos_emb)
     rope_theta: float = 10000.0
+    n_kv_heads: int = 0  # grouped-query attention; 0 -> n_heads (MHA)
     norm: str = "layernorm"  # layernorm | rmsnorm (both fp32)
     mlp: str = "gelu"  # gelu | swiglu (fused gate+up projection)
     mlp_hidden_size: int = 0  # 0 -> expansion_ratio * d_model
@@ -324,6 +325,10 @@ class Config:
             raise ValueError(f"bad model.mlp {self.model.mlp}")
         if self.model.rope and self.model.d_head % 2:
             raise ValueError("rope needs an even d_head")
+        if self.model.n_kv_heads < 0 or self.model.mlp_hidden_size < 0:
+            raise ValueError("n_kv_heads and mlp_hidden_size must be >= 0")
+        if self.model.n_kv_heads and self.model.n_heads % self.model.n_kv_heads:
+            raise ValueError("n_heads must be a multiple of n_kv_heads")
         _ = self.model.d_head
         return self
 

@@ -139,13 +139,35 @@ class MPTBlock(nn.Module):
 
         # --- attention ---
         h = _norm(cfg, "ln_1")(x)
-        qkv = dense(3 * cfg.d_model, "wqkv", cfg.emb_init_std)(h)
-        b, s, _ = qkv.shape
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (b, s, cfg.n_heads, cfg.d_head)
-        q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        n_kv = cfg.n_kv_heads or cfg.n_heads
+        b, s, _ = h.shape
+        if n_kv == cfg.n_heads:
+            qkv = dense(3 * cfg.d_model, "wqkv", cfg.emb_init_std)(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            # GQA: separate projections — a fused q||k||v matrix would put
+            # shard boundaries at positions that don't align with the
+            # tensor axis and force per-layer resharding; three
+            # column-parallel matmuls stay shard-local
+            q = dense(cfg.n_heads * cfg.d_head, "q_proj", cfg.emb_init_std)(h)
+            k = dense(n_kv * cfg.d_head, "k_proj", cfg.emb_init_std)(h)
+            v = dense(n_kv * cfg.d_head, "v_proj", cfg.emb_init_std)(h)
+        q = q.reshape(b, s, cfg.n_heads, cfg.d_head)
+        k = k.reshape(b, s, n_kv, cfg.d_head)
+        v = v.reshape(b, s, n_kv, cfg.d_head)
         if cfg.rope:
+            # before the kv repeat: the rotation is per-head-identical, so
+            # rotating n_kv heads then replicating equals the reverse order
             q, k = apply_rope(q, k, cfg.rope_theta)
+        if n_kv != cfg.n_heads:
+            # replicate kv groups up to n_heads ahead of the kernels. This
+            # keeps one kernel for MHA/GQA at the cost of materializing
+            # full-width kv activations: the projection-weight saving
+            # survives; the kv HBM/ring-transfer saving would need
+            # GQA-aware flash/ring kernels (future work)
+            rep = cfg.n_heads // n_kv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         attn_out = multihead_attention(
             q, k, v,
             impl=cfg.attn_impl, causal=True, alibi=cfg.alibi,

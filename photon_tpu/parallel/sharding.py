@@ -33,9 +33,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _RULES: list[tuple[str, P]] = [
     (r"wte/embedding$", P("fsdp", "tensor")),
     (r"^wpe$", P(None, "fsdp")),
-    (r"(wqkv|up_proj|gate_proj)/kernel$", P(None, "fsdp", "tensor")),
+    (r"(wqkv|up_proj|gate_proj|q_proj|k_proj|v_proj)/kernel$", P(None, "fsdp", "tensor")),
     (r"(out_proj|down_proj)/kernel$", P(None, "tensor", "fsdp")),
-    (r"(wqkv|up_proj|gate_proj)/bias$", P(None, "tensor")),
+    (r"(wqkv|up_proj|gate_proj|q_proj|k_proj|v_proj)/bias$", P(None, "tensor")),
     (r"(out_proj|down_proj)/bias$", P(None, "fsdp")),
     (r"lm_head/kernel$", P("tensor", "fsdp")),
     (r"(ln_1|ln_2|ln_f)/(scale|bias)$", P()),

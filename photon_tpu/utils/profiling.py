@@ -115,7 +115,10 @@ def model_flops_per_token(cfg: ModelConfig) -> float:
     hidden = cfg.mlp_hidden_size or cfg.expansion_ratio * d
     # gelu: up+down = 2·d·F weights; swiglu adds the gate = 3·d·F
     mlp_w = (3 if cfg.mlp == "swiglu" else 2) * d * hidden
-    n_block = L * (4 * d * d + mlp_w)  # qkv+out_proj / mlp
+    # GQA shrinks the kv projections: q + 2·kv groups + out_proj
+    n_kv = cfg.n_kv_heads or cfg.n_heads
+    attn_w = d * (cfg.n_heads + 2 * n_kv) * cfg.d_head + d * d
+    n_block = L * (attn_w + mlp_w)
     attn = 12 * L * d * s  # score + value matmuls, fwd+bwd
     head = 6 * d * v
     return 6.0 * n_block + attn + head
