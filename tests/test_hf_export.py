@@ -1,0 +1,135 @@
+"""HF checkpoint export round-trips: a llama-family model exported with
+``checkpoint/hf_export.py`` and reloaded through transformers'
+``LlamaForCausalLM`` must produce the SAME logits — the strongest possible
+check that the weight mapping, RoPE convention, RMSNorm, SwiGLU, and GQA
+semantics all agree with the public implementation.
+
+The mpt-foundry export is checked structurally (llm-foundry isn't
+installed; the naming contract is the reference's checkpoint module tree).
+"""
+
+import numpy as np
+import pytest
+
+from photon_tpu.config.schema import Config
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _llama_cfg(n_kv_heads: int = 0) -> Config:
+    cfg = Config()
+    cfg.model.d_model = 32
+    cfg.model.n_layers = 2
+    cfg.model.n_heads = 4
+    cfg.model.n_kv_heads = n_kv_heads
+    cfg.model.max_seq_len = 16
+    cfg.model.vocab_size = 96
+    cfg.model.attn_impl = "xla"
+    cfg.model.compute_dtype = "float32"
+    cfg.model.logits_dtype = "float32"
+    cfg.model.rope = True
+    cfg.model.learned_pos_emb = False
+    cfg.model.norm = "rmsnorm"
+    cfg.model.mlp = "swiglu"
+    cfg.model.mlp_hidden_size = 48
+    cfg.model.tie_embeddings = False
+    return cfg.validate()
+
+
+@pytest.mark.parametrize("n_kv", [0, 2], ids=["mha-fused", "gqa"])
+def test_llama_export_logit_parity(tmp_path, n_kv):
+    from photon_tpu.checkpoint.hf_export import save_hf_llama
+    from photon_tpu.models.mpt import MPTModel, init_params
+
+    cfg = _llama_cfg(n_kv)
+    params = init_params(cfg.model, seed=3)
+    model = MPTModel(cfg.model)
+    tokens = np.random.default_rng(0).integers(0, 96, (2, 12), dtype=np.int32)
+    ours = np.asarray(model.apply({"params": params}, tokens))
+
+    out = save_hf_llama(params, cfg.model, str(tmp_path / "hf"))
+    hf = transformers.LlamaForCausalLM.from_pretrained(
+        str(out), torch_dtype=torch.float32
+    )
+    hf.eval()
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)
+
+
+def test_llama_export_rejects_mpt_config(tmp_path):
+    from photon_tpu.checkpoint.hf_export import llama_state_dict
+    from photon_tpu.models.mpt import init_params
+
+    cfg = Config()
+    cfg.model.d_model = 32
+    cfg.model.n_layers = 1
+    cfg.model.n_heads = 2
+    cfg.model.vocab_size = 64
+    cfg.validate()
+    with pytest.raises(ValueError, match="llama export"):
+        llama_state_dict(init_params(cfg.model, seed=0), cfg.model)
+
+
+def test_llama_export_rejects_biased_config():
+    from photon_tpu.checkpoint.hf_export import llama_state_dict
+    from photon_tpu.models.mpt import init_params
+
+    cfg = _llama_cfg()
+    cfg.model.no_bias = False
+    cfg.validate()
+    with pytest.raises(ValueError, match="no_bias"):
+        llama_state_dict(init_params(cfg.model, seed=0), cfg.model)
+
+
+def test_foundry_mpt_state_dict_structure():
+    from photon_tpu.checkpoint.hf_export import foundry_mpt_state_dict
+    from photon_tpu.models.mpt import init_params
+
+    cfg = Config()
+    cfg.model.d_model = 32
+    cfg.model.n_layers = 2
+    cfg.model.n_heads = 2
+    cfg.model.max_seq_len = 16
+    cfg.model.vocab_size = 64
+    cfg.validate()
+    params = init_params(cfg.model, seed=0)
+    sd = foundry_mpt_state_dict(params, cfg.model)
+
+    pre = "model.transformer."
+    assert sd[pre + "wte.weight"].shape == (64, 32)
+    assert sd[pre + "wpe.weight"].shape == (16, 32)  # learned positions kept
+    for i in range(2):
+        assert sd[f"{pre}blocks.{i}.attn.Wqkv.weight"].shape == (96, 32)
+        assert sd[f"{pre}blocks.{i}.ffn.up_proj.weight"].shape == (128, 32)
+        assert sd[f"{pre}blocks.{i}.ffn.down_proj.weight"].shape == (32, 128)
+    # torch convention round-trip: Wqkv^T must equal our [in, out] kernel
+    ours = np.asarray(params["blocks"]["block"]["wqkv"]["kernel"][0])
+    np.testing.assert_array_equal(sd[pre + "blocks.0.attn.Wqkv.weight"].numpy().T, ours)
+    # tied embeddings: no separate lm_head entry
+    assert "model.lm_head.weight" not in sd
+
+
+def test_export_cli_roundtrip(tmp_path):
+    """The CLI path: npz dump -> exporter -> transformers loads it."""
+    from photon_tpu.checkpoint import arrays_to_npz
+    from photon_tpu.checkpoint.hf_export import main
+    from photon_tpu.codec import params_to_ndarrays
+    from photon_tpu.models.mpt import init_params
+
+    cfg = _llama_cfg()
+    params = init_params(cfg.model, seed=1)
+    meta, arrays = params_to_ndarrays(params)
+    npz = tmp_path / "params.npz"
+    npz.write_bytes(arrays_to_npz(meta, arrays))
+    cfg_yaml = tmp_path / "cfg.yaml"
+    cfg.to_yaml(str(cfg_yaml))
+
+    main(["--params-npz", str(npz), "--config", str(cfg_yaml),
+          "--out", str(tmp_path / "hf"), "--format", "llama"])
+    hf = transformers.LlamaForCausalLM.from_pretrained(
+        str(tmp_path / "hf"), torch_dtype=torch.float32
+    )
+    assert hf.config.num_hidden_layers == 2
