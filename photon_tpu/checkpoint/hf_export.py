@@ -51,7 +51,10 @@ def _t(arr: np.ndarray) -> "Any":
 def _w(arr: np.ndarray) -> "Any":
     import torch
 
-    return torch.from_numpy(np.ascontiguousarray(np.asarray(arr)).copy())
+    a = np.ascontiguousarray(np.asarray(arr))
+    if not a.flags.writeable:  # torch.from_numpy requires writable memory
+        a = a.copy()
+    return torch.from_numpy(a)
 
 
 def llama_state_dict(params: Any, cfg: ModelConfig) -> dict:
@@ -113,7 +116,7 @@ def llama_hf_config(cfg: ModelConfig, bos_token_id: int = 0,
         "max_position_embeddings": cfg.max_seq_len,
         "vocab_size": cfg.vocab_size,
         "rope_theta": cfg.rope_theta,
-        "rms_norm_eps": 1.0e-5,
+        "rms_norm_eps": cfg.norm_eps,
         "hidden_act": "silu",
         "attention_bias": not cfg.no_bias,
         "mlp_bias": not cfg.no_bias,

@@ -63,6 +63,7 @@ class ModelConfig:
     rope_theta: float = 10000.0
     n_kv_heads: int = 0  # grouped-query attention; 0 -> n_heads (MHA)
     norm: str = "layernorm"  # layernorm | rmsnorm (both fp32)
+    norm_eps: float = 1.0e-5  # checkpoint-interop-sensitive (rms_norm_eps)
     mlp: str = "gelu"  # gelu | swiglu (fused gate+up projection)
     mlp_hidden_size: int = 0  # 0 -> expansion_ratio * d_model
     attn_impl: str = AttnImpl.PALLAS.value

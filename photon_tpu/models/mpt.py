@@ -95,8 +95,8 @@ class FP32RMSNorm(nn.Module):
 
 def _norm(cfg: ModelConfig, name: str) -> nn.Module:
     if cfg.norm == "rmsnorm":
-        return FP32RMSNorm(name=name)
-    return FP32LayerNorm(use_bias=not cfg.no_bias, name=name)
+        return FP32RMSNorm(eps=cfg.norm_eps, name=name)
+    return FP32LayerNorm(use_bias=not cfg.no_bias, eps=cfg.norm_eps, name=name)
 
 
 def apply_rope(q: jax.Array, k: jax.Array, theta: float) -> tuple[jax.Array, jax.Array]:

@@ -39,3 +39,27 @@ def subprocess_env() -> dict:
     env["JAX_COMPILATION_CACHE_DIR"] = TEST_JAX_CACHE
     env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.5"
     return env
+
+
+def tiny_llama_config(n_kv_heads: int = 0):
+    """Shared tiny llama-family config for the checkpoint-interop tests
+    (kept in one place so export/import tests can't drift apart)."""
+    from photon_tpu.config.schema import Config
+
+    cfg = Config()
+    cfg.model.d_model = 32
+    cfg.model.n_layers = 2
+    cfg.model.n_heads = 4
+    cfg.model.n_kv_heads = n_kv_heads
+    cfg.model.max_seq_len = 16
+    cfg.model.vocab_size = 96
+    cfg.model.attn_impl = "xla"
+    cfg.model.compute_dtype = "float32"
+    cfg.model.logits_dtype = "float32"
+    cfg.model.rope = True
+    cfg.model.learned_pos_emb = False
+    cfg.model.norm = "rmsnorm"
+    cfg.model.mlp = "swiglu"
+    cfg.model.mlp_hidden_size = 48
+    cfg.model.tie_embeddings = False
+    return cfg.validate()

@@ -13,28 +13,10 @@ import pytest
 
 from photon_tpu.config.schema import Config
 
+from tests._helpers import tiny_llama_config
+
 torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
-
-
-def _llama_cfg(n_kv_heads: int = 0) -> Config:
-    cfg = Config()
-    cfg.model.d_model = 32
-    cfg.model.n_layers = 2
-    cfg.model.n_heads = 4
-    cfg.model.n_kv_heads = n_kv_heads
-    cfg.model.max_seq_len = 16
-    cfg.model.vocab_size = 96
-    cfg.model.attn_impl = "xla"
-    cfg.model.compute_dtype = "float32"
-    cfg.model.logits_dtype = "float32"
-    cfg.model.rope = True
-    cfg.model.learned_pos_emb = False
-    cfg.model.norm = "rmsnorm"
-    cfg.model.mlp = "swiglu"
-    cfg.model.mlp_hidden_size = 48
-    cfg.model.tie_embeddings = False
-    return cfg.validate()
 
 
 @pytest.mark.parametrize("n_kv", [0, 2], ids=["mha-fused", "gqa"])
@@ -42,7 +24,7 @@ def test_llama_export_logit_parity(tmp_path, n_kv):
     from photon_tpu.checkpoint.hf_export import save_hf_llama
     from photon_tpu.models.mpt import MPTModel, init_params
 
-    cfg = _llama_cfg(n_kv)
+    cfg = tiny_llama_config(n_kv)
     params = init_params(cfg.model, seed=3)
     model = MPTModel(cfg.model)
     tokens = np.random.default_rng(0).integers(0, 96, (2, 12), dtype=np.int32)
@@ -77,7 +59,7 @@ def test_llama_export_rejects_biased_config():
     from photon_tpu.checkpoint.hf_export import llama_state_dict
     from photon_tpu.models.mpt import init_params
 
-    cfg = _llama_cfg()
+    cfg = tiny_llama_config()
     cfg.model.no_bias = False
     cfg.validate()
     with pytest.raises(ValueError, match="no_bias"):
@@ -119,7 +101,7 @@ def test_export_cli_roundtrip(tmp_path):
     from photon_tpu.codec import params_to_ndarrays
     from photon_tpu.models.mpt import init_params
 
-    cfg = _llama_cfg()
+    cfg = tiny_llama_config()
     params = init_params(cfg.model, seed=1)
     meta, arrays = params_to_ndarrays(params)
     npz = tmp_path / "params.npz"

@@ -1,0 +1,132 @@
+"""HF → photon-tpu import: the inverse of the export mapping.
+
+Round-trip property: export a llama-family model to an HF directory, import
+it back, and (a) every leaf is bit-identical, (b) logits from the imported
+tree match the original model. Plus: importing a checkpoint written by
+transformers itself (save_pretrained, safetensors) — the real inbound
+format for public llama checkpoints.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from photon_tpu.config.schema import Config
+
+from tests._helpers import tiny_llama_config
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.mark.parametrize("n_kv", [0, 2], ids=["mha", "gqa"])
+def test_export_import_roundtrip_bit_identical(tmp_path, n_kv):
+    import jax
+
+    from photon_tpu.checkpoint.hf_export import save_hf_llama
+    from photon_tpu.checkpoint.hf_import import load_hf_llama
+    from photon_tpu.models.mpt import init_params
+
+    cfg = tiny_llama_config(n_kv)
+    params = init_params(cfg.model, seed=5)
+    out = save_hf_llama(params, cfg.model, str(tmp_path / "hf"))
+    derived, imported = load_hf_llama(str(out))
+
+    assert derived.n_kv_heads == cfg.model.n_kv_heads
+    assert derived.mlp_hidden_size == 48 and derived.rope
+
+    orig_leaves = jax.tree_util.tree_leaves_with_path(params)
+    imp_flat = dict(jax.tree_util.tree_leaves_with_path(imported))
+    assert len(orig_leaves) == len(imp_flat)
+    for path, leaf in orig_leaves:
+        np.testing.assert_array_equal(np.asarray(leaf), imp_flat[path], err_msg=str(path))
+
+
+def test_import_from_transformers_save_pretrained(tmp_path):
+    """A checkpoint written by transformers itself (safetensors) imports and
+    produces the same logits through OUR model as through HF."""
+    from photon_tpu.checkpoint.hf_import import load_hf_llama
+    from photon_tpu.models.mpt import MPTModel
+
+    hf_cfg = transformers.LlamaConfig(
+        hidden_size=32, intermediate_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=16,
+        vocab_size=96, rope_theta=10000.0, rms_norm_eps=1e-5,
+        attention_bias=False, mlp_bias=False, tie_word_embeddings=False,
+        torch_dtype="float32",
+    )
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    hf.eval()
+    hf.save_pretrained(str(tmp_path / "hf"))
+    assert (tmp_path / "hf" / "model.safetensors").exists()
+
+    model_cfg, params = load_hf_llama(str(tmp_path / "hf"))
+    model_cfg.attn_impl = "xla"
+    model_cfg.compute_dtype = "float32"
+    model = MPTModel(model_cfg)
+    tokens = np.random.default_rng(1).integers(0, 96, (2, 12), dtype=np.int32)
+    ours = np.asarray(model.apply({"params": params}, tokens))
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)
+
+
+def test_import_cli_writes_npz_and_yaml(tmp_path):
+    from photon_tpu.checkpoint import npz_to_arrays
+    from photon_tpu.checkpoint.hf_export import save_hf_llama
+    from photon_tpu.checkpoint.hf_import import main
+    from photon_tpu.models.mpt import init_params
+
+    cfg = tiny_llama_config()
+    params = init_params(cfg.model, seed=2)
+    save_hf_llama(params, cfg.model, str(tmp_path / "hf"))
+    out = tmp_path / "imported.npz"
+    main(["--hf-dir", str(tmp_path / "hf"), "--out", str(out)])
+    meta, arrays = npz_to_arrays(out.read_bytes())
+    assert meta.n_arrays == 10  # MHA tree: fused wqkv (GQA would be 12)
+    assert (tmp_path / "imported.model.yaml").exists()
+
+
+def test_import_rejects_mismatched_config(tmp_path):
+    from photon_tpu.checkpoint.hf_export import save_hf_llama
+    from photon_tpu.checkpoint.hf_import import load_hf_llama
+    from photon_tpu.models.mpt import init_params
+
+    cfg = tiny_llama_config()
+    save_hf_llama(init_params(cfg.model, seed=0), cfg.model, str(tmp_path / "hf"))
+    wrong = tiny_llama_config()
+    wrong.model.n_layers = 3
+    with pytest.raises(ValueError, match="config mismatch"):
+        load_hf_llama(str(tmp_path / "hf"), wrong.model)
+
+
+def test_import_rejects_tied_and_biased(tmp_path):
+    from photon_tpu.checkpoint.hf_import import model_config_from_hf
+
+    base = dict(model_type="llama", hidden_size=32, num_hidden_layers=2,
+                num_attention_heads=4, max_position_embeddings=16,
+                vocab_size=96, intermediate_size=48)
+    with pytest.raises(ValueError, match="tied"):
+        model_config_from_hf({**base, "tie_word_embeddings": True})
+    with pytest.raises(ValueError, match="biased"):
+        model_config_from_hf({**base, "attention_bias": True})
+    with pytest.raises(ValueError, match="model_type"):
+        model_config_from_hf({**base, "model_type": "mistral"})
+    with pytest.raises(ValueError, match="rope_scaling"):
+        model_config_from_hf(
+            {**base, "rope_scaling": {"rope_type": "llama3", "factor": 8.0}}
+        )
+
+
+def test_import_threads_norm_eps():
+    """rms_norm_eps from the checkpoint lands in the model config (and the
+    model's norms read it) instead of being silently pinned to 1e-5."""
+    from photon_tpu.checkpoint.hf_import import model_config_from_hf
+
+    m = model_config_from_hf(dict(
+        model_type="llama", hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=16,
+        vocab_size=96, intermediate_size=48, rms_norm_eps=1e-6,
+    ))
+    assert m.norm_eps == pytest.approx(1e-6)
