@@ -112,6 +112,7 @@ def main(argv: list[str] | None = None) -> None:
                 root_dir=args.tasks_root,
                 seq_len=min(cfg.model.max_seq_len, 512),
                 max_rows=args.icl_max_rows,
+                model_cfg=cfg.model,
             )
         )
 
@@ -135,6 +136,7 @@ def main(argv: list[str] | None = None) -> None:
                 tasks, tok, apply, params,
                 seq_len=min(cfg.model.max_seq_len, 512),
                 max_rows=args.icl_max_rows,
+                model_cfg=cfg.model,
             )
         )
 

@@ -241,6 +241,7 @@ def run_gauntlet_suite(
     seq_len: int = 256,
     batch_size: int = 16,
     max_rows: int | None = None,
+    model_cfg: Any = None,
 ) -> dict[str, float]:
     """YAML-driven gauntlet run: suite → tasks → raw scores → weighted
     category averages (the ``eval_gauntlet_only.sh`` analog)."""
@@ -254,7 +255,8 @@ def run_gauntlet_suite(
     raw: dict[str, float] = {}
     out: dict[str, float] = {}
     for task, res in score_tasks(
-        tasks, tokenizer, model_apply, params, seq_len, batch_size, max_rows
+        tasks, tokenizer, model_apply, params, seq_len, batch_size, max_rows,
+        model_cfg=model_cfg,
     ):
         # every task kind reports accuracy (LM = greedy exact-match,
         # llm-foundry's InContextLearningLMAccuracy) — that is what the

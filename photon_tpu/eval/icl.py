@@ -142,6 +142,16 @@ def make_logprob_fn(model_apply: Callable, params: Any, seq_len: int) -> Callabl
     return logprob
 
 
+def write_at_cursor(tokens: jax.Array, lengths: jax.Array, nxt: jax.Array) -> jax.Array:
+    """Place ``nxt [B]`` at each row's cursor (clamped to the last slot) —
+    the single definition of the greedy-decode write semantics, shared by
+    the full-forward and KV-cache decoders so they cannot drift."""
+    onehot = jax.nn.one_hot(
+        jnp.clip(lengths, 0, tokens.shape[1] - 1), tokens.shape[1], dtype=tokens.dtype
+    )
+    return tokens * (1 - onehot) + nxt[:, None] * onehot
+
+
 def make_generate_fn(model_apply: Callable, params: Any) -> Callable:
     """Jitted greedy-decode step: ``(tokens [B,S], lengths [B]) ->
     (tokens', lengths')`` appending one argmax token per row at its own
@@ -154,10 +164,7 @@ def make_generate_fn(model_apply: Callable, params: Any) -> Callable:
         idx = jnp.clip(lengths - 1, 0, tokens.shape[1] - 1)
         last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]  # [B, V]
         nxt = jnp.argmax(last, axis=-1).astype(tokens.dtype)  # [B]
-        onehot = jax.nn.one_hot(
-            jnp.clip(lengths, 0, tokens.shape[1] - 1), tokens.shape[1], dtype=tokens.dtype
-        )
-        tokens = tokens * (1 - onehot) + nxt[:, None] * onehot
+        tokens = write_at_cursor(tokens, lengths, nxt)
         return tokens, jnp.minimum(lengths + 1, tokens.shape[1])
 
     return step
@@ -206,8 +213,12 @@ def _evaluate_generation(
         toks = np.stack(chunk + [np.zeros(seq_len, np.int32)] * pad)
         cur = np.asarray(lens + [1] * pad, np.int32)
         toks_j, cur_j = jnp.asarray(toks), jnp.asarray(cur)
-        for _ in range(gen):
-            toks_j, cur_j = generate_fn(toks_j, cur_j)
+        many = getattr(generate_fn, "many", None)
+        if many is not None:  # KV-cache path: one prefill + n cheap steps
+            toks_j, cur_j = many(toks_j, cur_j, gen)
+        else:
+            for _ in range(gen):
+                toks_j, cur_j = generate_fn(toks_j, cur_j)
         out = np.asarray(toks_j)
         for k, row in enumerate(rows[start : start + batch_size]):
             text = tokenizer.decode(out[k, lens[k] : lens[k] + gen].tolist())
@@ -347,12 +358,22 @@ def score_tasks(
     seq_len: int,
     batch_size: int = 16,
     max_rows: int | None = None,
+    model_cfg: Any = None,
 ):
     """Build the jitted scorers ONCE and yield ``(task, result)`` pairs —
     the single scoring path shared by :func:`run_gauntlet` and
-    ``gauntlet.run_gauntlet_suite`` so policy changes land in one place."""
+    ``gauntlet.run_gauntlet_suite`` so policy changes land in one place.
+
+    With ``model_cfg`` the generation scorer uses the KV-cache decoder
+    (``models/decode.py`` — O(S) attention per new token instead of a full
+    forward); without it the full-forward decoder is used."""
     logprob_fn = make_logprob_fn(model_apply, params, seq_len)
-    generate_fn = make_generate_fn(model_apply, params)
+    if model_cfg is not None:
+        from photon_tpu.models.decode import make_cached_generate_fn
+
+        generate_fn = make_cached_generate_fn(model_cfg, params, model_apply)
+    else:
+        generate_fn = make_generate_fn(model_apply, params)
     for task in tasks:
         yield task, evaluate_task(
             task, tokenizer, logprob_fn, seq_len, batch_size,
@@ -368,6 +389,7 @@ def run_gauntlet(
     seq_len: int = 256,
     batch_size: int = 16,
     max_rows: int | None = None,
+    model_cfg: Any = None,
 ) -> dict[str, float]:
     """Evaluate all tasks; per-category averages subtract each task's random
     baseline and rescale (reference gauntlet averaging:
@@ -375,7 +397,8 @@ def run_gauntlet(
     out: dict[str, float] = {}
     by_cat: dict[str, list[float]] = {}
     for task, res in score_tasks(
-        tasks, tokenizer, model_apply, params, seq_len, batch_size, max_rows
+        tasks, tokenizer, model_apply, params, seq_len, batch_size, max_rows,
+        model_cfg=model_cfg,
     ):
         for k, v in res.items():
             if k != "n_rows":
