@@ -390,10 +390,16 @@ def run_gauntlet(
     batch_size: int = 16,
     max_rows: int | None = None,
     model_cfg: Any = None,
+    on_task: Callable | None = None,
 ) -> dict[str, float]:
     """Evaluate all tasks; per-category averages subtract each task's random
     baseline and rescale (reference gauntlet averaging:
-    ``eval_gauntlet_v0.3.yaml`` ``subtract_random_baseline/rescale``)."""
+    ``eval_gauntlet_v0.3.yaml`` ``subtract_random_baseline/rescale``).
+
+    ``on_task(task, result, partial_out)`` fires after each task — callers
+    with wall-clock budgets (bench evidence stages) flush partial artifacts
+    there and may raise to stop early; the exception propagates with
+    ``partial_out`` already populated for everything scored so far."""
     out: dict[str, float] = {}
     by_cat: dict[str, list[float]] = {}
     for task, res in score_tasks(
@@ -406,6 +412,8 @@ def run_gauntlet(
         if "accuracy" in res:
             score = (res["accuracy"] - task.random_baseline) / max(1.0 - task.random_baseline, 1e-9)
             by_cat.setdefault(task.category, []).append(max(score, 0.0))
+        if on_task is not None:
+            on_task(task, res, out)
     for cat, scores in by_cat.items():
         out[f"icl/category/{cat}"] = float(np.mean(scores))
     if by_cat:

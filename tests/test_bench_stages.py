@@ -85,6 +85,55 @@ def test_convergence_slice_deadline_skip(bench, monkeypatch, tmp_path):
     assert not (tmp_path / "CONVERGENCE_TPU.json").exists()
 
 
+def _tiny_byte_cfg():
+    """Byte-tokenizer-compatible tiny model (vocab must cover ids < 256)."""
+    cfg = _tiny_cfg()
+    cfg.model.vocab_size = 320
+    cfg.model.max_seq_len = 64
+    return cfg
+
+
+def test_convergence_slice_returns_params_and_gauntlet_scores(
+    bench, monkeypatch, tmp_path
+):
+    """The conv slice hands its trained params to the gauntlet stage, which
+    writes GAUNTLET_TPU.json with per-task scores through the real scorers
+    (byte tokenizer, cached decoder for the generation task)."""
+    import photon_tpu.config.schema as schema
+
+    monkeypatch.setattr(schema, "Config", _tiny_byte_cfg)
+    monkeypatch.setattr(
+        bench, "_corpus_tokens",
+        lambda: np.random.default_rng(0).integers(0, 250, 4000).astype(np.uint8),
+    )
+    monkeypatch.setattr(bench, "_GAUNTLET_SLICE_TASKS", [
+        "symbolic_problem_solving/svamp.jsonl",
+        "commonsense_reasoning/copa_demo.jsonl",
+    ])
+    # the stage resolves task files relative to HERE, which the fixture
+    # moved to tmp_path — point it back at the repo's local_data
+    import pathlib
+
+    (tmp_path / "photon_tpu" / "eval").mkdir(parents=True)
+    (tmp_path / "photon_tpu" / "eval" / "local_data").symlink_to(
+        pathlib.Path(__file__).parent.parent / "photon_tpu" / "eval" / "local_data"
+    )
+    monkeypatch.setenv("PHOTON_BENCH_CONV_GBS", "2")
+    monkeypatch.setenv("PHOTON_BENCH_CONV_STEPS", "2")
+    monkeypatch.setenv("PHOTON_BENCH_MICROBATCH", "2")
+    monkeypatch.delenv("PHOTON_BENCH_CHILD_DEADLINE", raising=False)
+    monkeypatch.delenv("PHOTON_BENCH_FLASH_BLOCK", raising=False)
+
+    params = bench.tpu_convergence_slice(_FakeDev())
+    assert params is not None and "wte" in params
+
+    bench.gauntlet_on_slice(params, _FakeDev())
+    out = json.loads((tmp_path / "GAUNTLET_TPU.json").read_text())
+    assert out["complete"], out.get("error")
+    assert set(out["tasks"]) == {"svamp", "copa_demo"}
+    assert "icl/average" in out["scores"]
+
+
 def test_one_b_probe_predicted_vs_measured(bench, monkeypatch, tmp_path):
     import photon_tpu.config as config_mod
 
