@@ -17,4 +17,4 @@ Architecture (TPU-first, not a port):
   (reference: CUDA flash-attention).
 """
 
-__version__ = "0.5.0"  # keep in lockstep with pyproject.toml [project] version
+__version__ = "0.5.0"  # single source of truth (pyproject reads it via dynamic)

@@ -29,8 +29,11 @@ from photon_tpu.config.schema import MeshConfig
         ("mpt-3b", dict(fsdp=4, tensor=2), 2, (2.4e9, 2.9e9)),
         # 7B needs 32 chips; fsdp8xtp4 fits where fsdp16xtp2 (36 GiB) won't
         ("mpt-7b", dict(fsdp=8, tensor=4), 2, (6.2e9, 7.2e9)),
+        # llama family at 1B scale: RoPE/RMSNorm/SwiGLU/GQA params shard
+        # under the same rules (separate q/k/v + gate/up projections)
+        ("llama-1b", dict(fsdp=4, tensor=2), 2, (1.0e9, 1.2e9)),
     ],
-    ids=["1b-8dev", "3b-8dev", "7b-32dev"],
+    ids=["1b-8dev", "3b-8dev", "7b-32dev", "llama1b-8dev"],
 )
 def test_preset_train_step_compiles_sharded(preset, mesh_kw, micro, params_range):
     from jax.sharding import NamedSharding
