@@ -1063,6 +1063,7 @@ def run(platform: str) -> None:
         "flash_block": cfg.model.flash_block_q,
         "loss_chunk_tokens": cfg.train.loss_chunk_tokens,
         "final_loss": round(loss, 3),
+        "jax_version": jax.__version__,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     if not on_tpu:

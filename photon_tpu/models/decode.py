@@ -214,6 +214,22 @@ def decode_step(params: dict, state: DecodeState, token: jax.Array,
     )
 
 
+def generate(params: Any, tokens: jax.Array, lengths: jax.Array,
+             cfg: ModelConfig, max_new_tokens: int, *,
+             temperature: float = 0.0, top_k: int = 0,
+             seed: int = 0) -> tuple[jax.Array, jax.Array]:
+    """One-shot convenience over :func:`make_cached_generate_fn`:
+    ``temperature == 0`` is greedy argmax (deterministic, the eval path);
+    otherwise logits/temperature are sampled, optionally truncated to the
+    ``top_k`` highest first (the sampling surface HF ``generate`` gives
+    reference users). For repeated calls hold a ``make_cached_generate_fn``
+    result instead — this builds (and re-traces) the jitted prefill/step
+    pair per invocation."""
+    fn = make_cached_generate_fn(cfg, params)
+    return fn.many(tokens, lengths, max_new_tokens,
+                   temperature=temperature, top_k=top_k, seed=seed)
+
+
 def make_cached_generate_fn(cfg: ModelConfig, params: Any,
                             model_apply: Any = None):
     """Drop-in for ``eval/icl.py:make_generate_fn`` exposing the faster
@@ -231,8 +247,10 @@ def make_cached_generate_fn(cfg: ModelConfig, params: Any,
         lambda st, tok: decode_step(params, st, tok, cfg), donate_argnums=0
     )
 
-    def many(tokens, lengths, n: int):
-        """Greedy-decode ``n`` tokens; enforces ``max(lengths) + n <= S`` —
+    def many(tokens, lengths, n: int, *, temperature: float = 0.0,
+             top_k: int = 0, seed: int = 0):
+        """Decode ``n`` tokens — greedy at ``temperature == 0`` (the eval
+        default), sampled otherwise. Enforces ``max(lengths) + n <= S`` —
         past the buffer end the one-hot cache write would silently drop
         k/v and decode from a stale cache."""
         if int(jnp.max(lengths)) + n > tokens.shape[1]:
@@ -240,9 +258,21 @@ def make_cached_generate_fn(cfg: ModelConfig, params: Any,
                 f"decode overflow: max length {int(jnp.max(lengths))} + "
                 f"{n} new tokens > buffer {tokens.shape[1]}"
             )
+
+        def pick(logits, key):
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1)
+            scaled = logits.astype(jnp.float32) / temperature
+            if top_k:
+                kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            return jax.random.categorical(key, scaled, axis=-1)
+
+        key = jax.random.PRNGKey(seed)
         logits, st = prefill_jit(tokens, lengths)
         for i in range(n):
-            nxt = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+            key, sub = jax.random.split(key)
+            nxt = pick(logits, sub).astype(tokens.dtype)
             tokens = write_at_cursor(tokens, st.lengths, nxt)
             if i < n - 1:  # the last token's successor logits are unused
                 logits, st = step_jit(st, nxt)

@@ -138,6 +138,42 @@ def test_many_rejects_buffer_overflow():
         fn.many(tokens, jnp.asarray([6], jnp.int32), 4)
 
 
+def test_generate_sampling_modes():
+    """temperature=0 is exactly the greedy cached path; sampled tokens stay
+    inside the top-k support; fixed seed reproduces."""
+    from photon_tpu.models.decode import generate, make_cached_generate_fn, prefill
+    from photon_tpu.models.mpt import init_params
+
+    cfg = _mpt_cfg(alibi=False)
+    params = init_params(cfg.model, seed=0)
+    tokens = np.zeros((2, 16), np.int32)
+    tokens[:, :3] = [[5, 9, 2], [7, 1, 4]]
+    lengths = np.asarray([3, 3], np.int32)
+    tj, lj = jnp.asarray(tokens), jnp.asarray(lengths)
+
+    greedy, _ = generate(params, tj, lj, cfg.model, 5, temperature=0.0)
+    fn = make_cached_generate_fn(cfg.model, params)
+    oracle, _ = fn.many(tj, lj, 5)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(oracle))
+
+    s1, _ = generate(params, tj, lj, cfg.model, 5, temperature=1.0, seed=7)
+    s2, _ = generate(params, tj, lj, cfg.model, 5, temperature=1.0, seed=7)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))  # same seed
+
+    # top_k=1 collapses sampling back to greedy regardless of temperature
+    k1, _ = generate(params, tj, lj, cfg.model, 5, temperature=2.0, top_k=1, seed=3)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+
+    # top_k support: the first sampled token must be among the top-k logits
+    k = 4
+    logits, _ = prefill(params, tj, lj, cfg.model)
+    topk_ids = np.asarray(jax.lax.top_k(logits, k)[1])
+    sk, _ = generate(params, tj, lj, cfg.model, 1, temperature=1.5, top_k=k, seed=11)
+    first = np.asarray(sk)[np.arange(2), lengths]
+    for b in range(2):
+        assert first[b] in topk_ids[b], (first[b], topk_ids[b])
+
+
 def test_cached_generate_matches_full_forward_bf16():
     """The production compute dtype: bf16 end to end, cached == full."""
     from photon_tpu.eval.icl import make_generate_fn
