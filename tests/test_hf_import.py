@@ -72,6 +72,30 @@ def test_import_from_transformers_save_pretrained(tmp_path):
     np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)
 
 
+def test_import_sharded_safetensors(tmp_path):
+    """Index-file checkpoints (the format large public llamas actually ship
+    in) load through the shard-merging path."""
+    from photon_tpu.checkpoint.hf_import import load_hf_llama
+
+    hf_cfg = transformers.LlamaConfig(
+        hidden_size=32, intermediate_size=48, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=16, vocab_size=96,
+        attention_bias=False, mlp_bias=False, tie_word_embeddings=False,
+    )
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    hf.save_pretrained(str(tmp_path / "hf"), max_shard_size="20KB")
+    index = tmp_path / "hf" / "model.safetensors.index.json"
+    assert index.exists(), "test setup: shard size did not force an index"
+    n_shards = len(set(json.loads(index.read_text())["weight_map"].values()))
+    assert n_shards > 1
+
+    model_cfg, params = load_hf_llama(str(tmp_path / "hf"))
+    got = np.asarray(params["wte"]["embedding"])
+    want = hf.model.embed_tokens.weight.detach().numpy()
+    np.testing.assert_array_equal(got, want)
+    assert model_cfg.n_layers == 2
+
+
 def test_import_cli_writes_npz_and_yaml(tmp_path):
     from photon_tpu.checkpoint import npz_to_arrays
     from photon_tpu.checkpoint.hf_export import save_hf_llama
