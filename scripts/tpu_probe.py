@@ -118,7 +118,41 @@ def auto(gbs: int) -> None:
     print(json.dumps({"results": results, "tuned": tuned}, indent=2), flush=True)
 
 
+def _relay_preflight() -> None:
+    """Fail FAST when the axon relay is down: ``jax.devices()`` against a
+    dead relay parks in an infinite nanosleep retry loop with zero sockets
+    (round-5 diagnosis). The relay listens on 808x; if the env says we're
+    on the relay path and no such listener exists, exit with an actionable
+    message instead of hanging the session."""
+    import os
+
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return  # not the relay path (e.g. real TPU VM or CPU)
+    # PASSIVE check only (parse /proc/net/tcp for LISTEN on 8081-8083):
+    # actually dialing the relay is itself a wedge vector — an unidentified
+    # connect+close can disturb a live claimant on this single-claim relay
+    want = {f"{p:04X}" for p in (8081, 8082, 8083)}
+    listening = False
+    for path in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(path) as f:
+                for line in f.readlines()[1:]:
+                    cols = line.split()
+                    if len(cols) > 3 and cols[3] == "0A" \
+                            and cols[1].rsplit(":", 1)[-1] in want:
+                        listening = True
+        except OSError:
+            continue
+    if listening:
+        return
+    log("FATAL: no axon relay listener on 127.0.0.1:808x — jax.devices() "
+        "would hang forever. The relay is dead (nothing in-container "
+        "restarts it); run CPU-side work and retry later.")
+    sys.exit(3)
+
+
 def main() -> None:
+    _relay_preflight()
     dev = jax.devices()[0]
     log(f"device: {dev} kind={dev.device_kind}")
     if sys.argv[1:] and sys.argv[1] == "--auto":
