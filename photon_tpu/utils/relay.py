@@ -1,0 +1,35 @@
+"""Axon-relay liveness, shared by bench.py and scripts/tpu_probe.py.
+
+The TPU chip is reached through a local stdio<->TCP relay that listens on a
+fixed port set on 127.0.0.1. When the relay dies (observed round 4/5: after a
+TPU client is SIGKILLed mid-claim), ``jax.devices()`` parks in an infinite
+retry loop with zero sockets — so callers preflight HERE and fail fast with
+an actionable message instead.
+
+PASSIVE check only (parse /proc/net/tcp for LISTEN state): actually dialing
+the relay is itself a wedge vector — an unidentified connect+close can
+disturb a live claimant on this single-claim relay.
+"""
+
+from __future__ import annotations
+
+# The relay's full listening set (mirrors the deployed relay's PORTS list).
+RELAY_PORTS = (8082, 8083, 8087, 8092, 8093, 8097, 8102, 8103, 8107, 8112, 8113, 8117)
+
+
+def relay_listening() -> bool:
+    """True when at least one relay port is in LISTEN state on localhost."""
+    want = {f"{p:04X}" for p in RELAY_PORTS}
+    for path in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(path) as f:
+                for line in f.readlines()[1:]:
+                    cols = line.split()
+                    # cols[1] = local addr "HEXIP:HEXPORT", cols[3] = state
+                    # (0A == LISTEN)
+                    if len(cols) > 3 and cols[3] == "0A" \
+                            and cols[1].rsplit(":", 1)[-1] in want:
+                        return True
+        except OSError:
+            continue
+    return False

@@ -1,0 +1,153 @@
+"""Offline TPU compile check: compile the training step for a v5e topology
+on a CPU-only box, with NO relay / no chip involved.
+
+Round-5 motivation: the first live-relay session showed the remote compile
+service (``PALLAS_AXON_REMOTE_COMPILE=1``) can hang >22 min on the full-recipe
+train step while small programs compile fine. This harness drives the SAME
+XLA:TPU + Mosaic compiler locally via ``jax.experimental.topologies`` and the
+in-image ``libtpu.so``, so a hang/crash can be reproduced, bisected, and fixed
+entirely offline — and a clean run gives the true compile cost plus an AOT
+memory/FLOPs analysis for any config.
+
+Usage:
+    python scripts/aot_compile_check.py [--micro 2] [--gbs 256] [--impl pallas]
+        [--block 256] [--chunk 2048] [--remat] [--layers N] [--seq N]
+
+Prints one JSON line: {"ok", "lower_s", "compile_s", "hbm_gib", ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+# libtpu topology init wants the env a real TPU VM would have; mirror the
+# axon local-compile path (TPU_SKIP_MDS_QUERY avoids the GCP metadata-server
+# query that hangs off-VM)
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-4")
+os.environ.setdefault("TPU_TOPOLOGY", "2x2")
+os.environ["TPU_WORKER_HOSTNAMES"] = "localhost"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # never touch the axon relay
+
+import numpy as np  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(f"[aot] {msg}", file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--micro", type=int, default=2)
+    ap.add_argument("--gbs", type=int, default=256)
+    ap.add_argument("--impl", default="pallas", choices=["pallas", "xla"])
+    ap.add_argument("--block", type=int, default=0, help="flash tile (q=k)")
+    ap.add_argument("--chunk", type=int, default=2048, help="loss chunk tokens (0 = off)")
+    ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--layers", type=int, default=0, help="override n_layers")
+    ap.add_argument("--seq", type=int, default=0, help="override max_seq_len")
+    ap.add_argument("--preset", default="", help="config preset name (default: 125M recipe)")
+    args = ap.parse_args()
+
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from photon_tpu.config import load_preset
+    from photon_tpu.config.schema import Config
+    from photon_tpu.models import MPTModel, init_params
+    from photon_tpu.optim import build_optimizer
+    from photon_tpu.train import init_train_state
+    from photon_tpu.train.train_step import make_train_step
+
+    # force the REAL Mosaic lowering: pallas_supported() sees a CPU default
+    # backend under AOT tracing and would silently fall back to XLA attention
+    import photon_tpu.ops.flash_attention as fa
+
+    fa.pallas_supported = lambda x: True  # noqa: ARG005
+
+    cfg = load_preset(args.preset) if args.preset else Config()
+    cfg.model.attn_impl = args.impl
+    cfg.model.remat = args.remat
+    if args.block:
+        cfg.model.flash_block_q = args.block
+        cfg.model.flash_block_k = args.block
+    if args.layers:
+        cfg.model.n_layers = args.layers
+    if args.seq:
+        cfg.model.max_seq_len = args.seq
+    cfg.train.device_microbatch_size = args.micro
+    cfg.train.global_batch_size = args.gbs
+    cfg.train.loss_chunk_tokens = args.chunk
+    cfg.validate()
+
+    topo = topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x2x1")
+    dev = topo.devices[0]
+    log(f"abstract device: {dev.device_kind}")
+    mesh = Mesh(np.array(topo.devices[:1]), ("d",))
+    repl = NamedSharding(mesh, PartitionSpec())
+
+    model = MPTModel(cfg.model)
+    tx, _ = build_optimizer(cfg.optimizer, cfg.scheduler)
+    params = jax.eval_shape(lambda: init_params(cfg.model, seed=0))
+    state = jax.eval_shape(lambda p: init_train_state(model, tx, p), params)
+    state = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=repl), state
+    )
+    tok = jax.ShapeDtypeStruct(
+        (args.gbs, cfg.model.max_seq_len), jax.numpy.int32, sharding=repl
+    )
+    step = make_train_step(
+        model, tx, n_microbatches=args.gbs // args.micro,
+        loss_chunk_tokens=args.chunk,
+    )
+
+    from photon_tpu.utils.heartbeat import heartbeat
+
+    t0 = time.perf_counter()
+    with heartbeat("[aot] still compiling"):
+        lowered = jax.jit(step, donate_argnums=0).lower(state, tok)
+        t1 = time.perf_counter()
+        log(f"lowered in {t1 - t0:.1f}s")
+        compiled = lowered.compile()
+    t2 = time.perf_counter()
+    log(f"compiled in {t2 - t1:.1f}s")
+
+    out = {
+        "ok": True,
+        "impl": args.impl,
+        "block": args.block or cfg.model.flash_block_q,
+        "chunk": args.chunk,
+        "micro": args.micro,
+        "gbs": args.gbs,
+        "remat": args.remat,
+        "layers": cfg.model.n_layers,
+        "seq": cfg.model.max_seq_len,
+        "lower_s": round(t1 - t0, 1),
+        "compile_s": round(t2 - t1, 1),
+        "device_kind": dev.device_kind,
+    }
+    try:
+        ma = compiled.memory_analysis()
+        out["hbm_gib"] = round(
+            (ma.argument_size_in_bytes + ma.output_size_in_bytes
+             + ma.temp_size_in_bytes) / 2**30, 2)
+        out["temp_gib"] = round(ma.temp_size_in_bytes / 2**30, 2)
+    except Exception as e:  # noqa: BLE001 — analysis is best-effort
+        out["hbm_gib"] = None
+        log(f"memory_analysis unavailable: {e}")
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

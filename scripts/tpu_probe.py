@@ -49,6 +49,10 @@ def probe(remat: bool, micro: int, gbs: int, steps: int, impl: str = "pallas",
         cfg.model.flash_block_k = block
     cfg.train.device_microbatch_size = micro
     cfg.train.global_batch_size = gbs
+    import os
+
+    if os.environ.get("PHOTON_PROBE_NO_CHUNK") == "1":
+        cfg.train.loss_chunk_tokens = 0  # isolate chunked-CE compile cost
     cfg.validate()
     seq = cfg.model.max_seq_len
 
@@ -59,8 +63,14 @@ def probe(remat: bool, micro: int, gbs: int, steps: int, impl: str = "pallas",
     def batch():
         return rng.integers(0, cfg.model.vocab_size, (gbs, seq), dtype=np.int32)
 
-    trainer.state, m0 = trainer._train_step(trainer.state, batch())
-    float(m0["loss"])
+    # visible heartbeat while the (possibly multi-minute) remote compile RPC
+    # is in flight — a wedge then shows as unbounded "still compiling" lines
+    # with zero client CPU, not silent mystery
+    from photon_tpu.utils.heartbeat import heartbeat
+
+    with heartbeat("[probe]     still compiling"):
+        trainer.state, m0 = trainer._train_step(trainer.state, batch())
+        float(m0["loss"])
     compile_s = time.perf_counter() - t0
     trainer.state, m0 = trainer._train_step(trainer.state, batch())
     float(m0["loss"])
@@ -121,31 +131,17 @@ def auto(gbs: int) -> None:
 def _relay_preflight() -> None:
     """Fail FAST when the axon relay is down: ``jax.devices()`` against a
     dead relay parks in an infinite nanosleep retry loop with zero sockets
-    (round-5 diagnosis). The relay listens on 808x; if the env says we're
-    on the relay path and no such listener exists, exit with an actionable
-    message instead of hanging the session."""
+    (round-5 diagnosis). Port-set + passive /proc/net/tcp scan live in
+    ``photon_tpu.utils.relay`` (shared with bench.py)."""
     import os
 
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
         return  # not the relay path (e.g. real TPU VM or CPU)
-    # PASSIVE check only (parse /proc/net/tcp for LISTEN on 8081-8083):
-    # actually dialing the relay is itself a wedge vector — an unidentified
-    # connect+close can disturb a live claimant on this single-claim relay
-    want = {f"{p:04X}" for p in (8081, 8082, 8083)}
-    listening = False
-    for path in ("/proc/net/tcp", "/proc/net/tcp6"):
-        try:
-            with open(path) as f:
-                for line in f.readlines()[1:]:
-                    cols = line.split()
-                    if len(cols) > 3 and cols[3] == "0A" \
-                            and cols[1].rsplit(":", 1)[-1] in want:
-                        listening = True
-        except OSError:
-            continue
-    if listening:
+    from photon_tpu.utils.relay import relay_listening
+
+    if relay_listening():
         return
-    log("FATAL: no axon relay listener on 127.0.0.1:808x — jax.devices() "
+    log("FATAL: no axon relay listener on 127.0.0.1 — jax.devices() "
         "would hang forever. The relay is dead (nothing in-container "
         "restarts it); run CPU-side work and retry later.")
     sys.exit(3)

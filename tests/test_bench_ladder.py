@@ -1,0 +1,209 @@
+"""Supervisor ladder logic in bench.py (bank-then-upgrade, round 5).
+
+The supervisor never imports jax, so these tests run it in-process with a
+stubbed ``_Child`` that replays canned (stdout, stderr, rc, stalled)
+outcomes per rung — no subprocess, no relay, no chip.
+"""
+
+import importlib.util
+import json
+import pathlib
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    spec = importlib.util.spec_from_file_location("bench_under_test", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.delenv("PHOTON_BENCH_PLATFORM", raising=False)
+    monkeypatch.delenv("PHOTON_BENCH_MICROBATCH", raising=False)
+    return mod
+
+
+def _result_line(bench, value, **extra):
+    obj = {"metric": bench.METRIC, "value": value, "unit": "tokens/sec",
+           "vs_baseline": round(value / bench.A100_EST_TOKENS_PER_SEC, 4),
+           **extra}
+    return json.dumps(obj)
+
+
+class FakeChild:
+    """Replays the scripted outcome for the rung order in which it's built."""
+
+    script: list[dict] = []
+    built: list[dict] = []
+
+    def __init__(self, cmd, env, hard_timeout, idle_timeout,
+                 compile_idle_timeout=None):
+        spec = dict(self.script[len(self.built)])
+        self.built.append({"env": env, "spec": spec})
+        self._spec = spec
+        self.stdout = spec.get("stdout", "")
+        self.stderr = spec.get("stderr", "")
+        self._device_ok = spec.get("device_ok", True)
+
+    def wait(self):
+        return self._spec.get("rc", 0), self._spec.get("stalled", False)
+
+
+@pytest.fixture()
+def scripted(bench, monkeypatch, capsys):
+    def run_ladder(script):
+        FakeChild.script = script
+        FakeChild.built = []
+        monkeypatch.setattr(bench, "_Child", FakeChild)
+        rc = bench.supervise()
+        assert rc == 0
+        out = capsys.readouterr().out
+        final = json.loads(out.strip().splitlines()[-1])
+        return final, FakeChild.built
+
+    return run_ladder
+
+
+def test_full_rung_upgrades_safe_result(bench, scripted):
+    final, built = scripted([
+        {"stdout": _result_line(bench, 30000.0), "stderr": "backend up\ncompile+step in 30s"},
+        {"stdout": _result_line(bench, 65000.0), "stderr": "backend up\ncompile+step in 31s"},
+    ])
+    assert final["value"] == 65000.0
+    assert [a["rung"] for a in final["attempts"]] == ["tpu-safe", "tpu-full-local"]
+    # the local rung must force local compilation
+    assert built[1]["env"]["PALLAS_AXON_REMOTE_COMPILE"] == "0"
+    # the safe rung must keep Mosaic out and pin the proven config
+    assert built[0]["env"]["PHOTON_BENCH_ATTN"] == "xla"
+    assert built[0]["env"]["PHOTON_BENCH_MICROBATCH"] == "2"
+
+
+def test_stalled_full_rung_keeps_banked_safe_result(bench, scripted):
+    final, _ = scripted([
+        {"stdout": _result_line(bench, 30000.0), "stderr": "backend up\ncompile+step in 30s"},
+        {"stdout": "", "stderr": "backend up", "rc": None, "stalled": True},
+    ])
+    assert final["value"] == 30000.0
+    assert final["attempts"][1]["outcome"] == "hang-or-relay-wedge"
+    # remote rung NOT attempted after a stall (claim may be wedged)
+    assert len(final["attempts"]) == 2
+    # safe rung skipped parity and the full rung never delivered it: the
+    # final JSON must say so explicitly, not look like parity was skipped
+    assert final["kernel_parity_ok"] is False
+    assert "parity not run" in final["kernel_parity_error"]
+
+
+def test_dead_relay_skips_all_tpu_rungs(bench, scripted):
+    final, built = scripted([
+        {"stdout": "", "stderr": "RuntimeError: dead-relay: no axon relay listener",
+         "rc": 1, "device_ok": False},
+        {"stdout": _result_line(bench, 120.0, degraded="cpu-smoke-fallback"),
+         "stderr": "backend up"},
+    ])
+    assert final["degraded"].startswith("cpu-smoke")
+    assert [a["rung"] for a in final["attempts"]] == ["tpu-safe", "cpu-fallback"]
+
+
+def test_full_rung_oom_triggers_reduced_retry(bench, scripted):
+    final, built = scripted([
+        {"stdout": _result_line(bench, 30000.0), "stderr": "backend up\ncompile+step in 30s"},
+        {"stdout": "", "stderr": "backend up\nRESOURCE_EXHAUSTED", "rc": 1},
+        {"stdout": _result_line(bench, 50000.0), "stderr": "backend up\ncompile+step in 33s"},
+    ])
+    assert final["value"] == 50000.0
+    rungs = [a["rung"] for a in final["attempts"]]
+    assert rungs == ["tpu-safe", "tpu-full-local", "tpu-full-oom-reduced"]
+    # reduced retry must re-probe the microbatch and turn remat on
+    env = built[2]["env"]
+    assert "PHOTON_BENCH_MICROBATCH" not in env
+    assert env["PHOTON_BENCH_REMAT"] == "1"
+
+
+def test_remote_oom_retries_in_remote_mode(bench, scripted):
+    # local mode fails clean (mode unavailable) -> remote runs and OOMs ->
+    # the reduced retry must NOT force local mode back on
+    final, built = scripted([
+        {"stdout": _result_line(bench, 30000.0), "stderr": "backend up\ncompile+step in 30s"},
+        {"stdout": "", "stderr": "backend up\nlocal-compile mode unsupported", "rc": 1},
+        {"stdout": "", "stderr": "backend up\nRESOURCE_EXHAUSTED", "rc": 1},
+        {"stdout": _result_line(bench, 48000.0), "stderr": "backend up\ncompile+step in 35s"},
+    ])
+    rungs = [a["rung"] for a in final["attempts"]]
+    assert rungs == ["tpu-safe", "tpu-full-local", "tpu-full-remote",
+                     "tpu-full-oom-reduced"]
+    assert built[3]["env"].get("PALLAS_AXON_REMOTE_COMPILE") != "0"
+    assert final["value"] == 48000.0
+
+
+def test_tuned_config_crash_falls_back_to_auto_probe(bench, scripted, tmp_path):
+    # both full rungs crash non-OOM (e.g. stale bench_tuned.json pins a tile
+    # Mosaic rejects): one unpinned auto-probe attempt recovers the recipe
+    final, built = scripted([
+        {"stdout": _result_line(bench, 30000.0), "stderr": "backend up\ncompile+step in 30s"},
+        {"stdout": "", "stderr": "backend up\nMosaic rejects tile", "rc": 1},
+        {"stdout": "", "stderr": "backend up\nMosaic rejects tile", "rc": 1},
+        {"stdout": _result_line(bench, 55000.0), "stderr": "backend up\ncompile+step in 40s"},
+    ])
+    rungs = [a["rung"] for a in final["attempts"]]
+    assert rungs == ["tpu-safe", "tpu-full-local", "tpu-full-remote",
+                     "tpu-full-auto"]
+    # the tuned pin (bench_tuned.json) rides the full rungs but the auto
+    # rung drops it so the in-child probe re-discovers the config
+    assert built[1]["env"].get("PHOTON_BENCH_MICROBATCH") == "2"
+    assert "PHOTON_BENCH_MICROBATCH" not in built[3]["env"]
+    assert final["value"] == 55000.0
+
+
+def test_full_rung_crash_after_emit_stamps_parity_death(bench, scripted):
+    final, _ = scripted([
+        {"stdout": _result_line(bench, 30000.0), "stderr": "backend up\ncompile+step in 30s"},
+        {"stdout": _result_line(bench, 65000.0),
+         "stderr": "backend up\ncompile+step in 31s\nboom", "rc": 1},
+    ])
+    assert final["value"] == 65000.0
+    assert final["kernel_parity_ok"] is False
+    assert "died/stalled" in final["kernel_parity_error"]
+
+
+def test_slower_full_rung_donates_parity_to_safe_result(bench, scripted):
+    final, _ = scripted([
+        {"stdout": _result_line(bench, 60000.0), "stderr": "backend up\ncompile+step in 30s"},
+        {"stdout": _result_line(bench, 40000.0, kernel_parity_ok=True),
+         "stderr": "backend up\ncompile+step in 31s"},
+    ])
+    assert final["value"] == 60000.0
+    assert final["kernel_parity_ok"] is True
+
+
+def test_service_sick_with_broken_local_mode_skips_auto_rung(bench, scripted):
+    # safe rung stalls after device contact (remote compile sick); the local
+    # rung dies before reaching the device (mode broken) — the auto rung
+    # would repeat the identical mode failure, so skip straight to cpu
+    final, _ = scripted([
+        {"stdout": "", "stderr": "backend up", "rc": None, "stalled": True,
+         "device_ok": True},
+        {"stdout": "", "stderr": "register failed: local mode unsupported",
+         "rc": 1, "device_ok": False},
+        {"stdout": _result_line(bench, 120.0, degraded="cpu-smoke-fallback"),
+         "stderr": "backend up"},
+    ])
+    rungs = [a["rung"] for a in final["attempts"]]
+    assert rungs == ["tpu-safe", "tpu-full-local", "cpu-fallback"]
+
+
+def test_service_sick_goes_local_only_then_banks_nothing(bench, scripted):
+    # safe rung reached the device but the remote compile never returned;
+    # the local rung is still tried, and its stall ends the TPU attempts
+    final, _ = scripted([
+        {"stdout": "", "stderr": "backend up", "rc": None, "stalled": True,
+         "device_ok": True},
+        {"stdout": "", "stderr": "", "rc": None, "stalled": True,
+         "device_ok": False},
+        {"stdout": _result_line(bench, 120.0, degraded="cpu-smoke-fallback"),
+         "stderr": "backend up"},
+    ])
+    rungs = [a["rung"] for a in final["attempts"]]
+    assert rungs == ["tpu-safe", "tpu-full-local", "cpu-fallback"]
+    assert final["degraded"].startswith("cpu-smoke")
