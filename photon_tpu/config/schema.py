@@ -15,6 +15,7 @@ import enum
 import json
 import pathlib
 import typing
+import warnings
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -80,6 +81,11 @@ class ModelConfig:
     # ignored by the xla fallback.
     flash_block_q: int = 256
     flash_block_k: int = 256
+    # Run pallas kernels in the Pallas interpreter (CPU-executable). Test /
+    # dryrun knob: lets the virtual-device mesh exercise the REAL sharded
+    # flash program (shard_map + kernel) instead of silently falling back
+    # to XLA attention off-TPU. Never set on real hardware.
+    attn_interpret: bool = False
 
     @property
     def d_head(self) -> int:
@@ -312,6 +318,16 @@ class Config:
             raise ValueError("global_batch_size must be divisible by device_microbatch_size")
         StrategyName(self.fl.strategy_name)
         AttnImpl(self.model.attn_impl)
+        if self.mesh.sequence > 1 and self.model.attn_impl == AttnImpl.PALLAS.value:
+            # a sequence-sharded mesh needs the ring (context-parallel)
+            # dispatch: the plain pallas call sees sequence-sharded operands
+            # GSPMD cannot partition (Mosaic kernels aren't auto-partitioned)
+            warnings.warn(
+                "mesh.sequence > 1 with attn_impl=pallas: upgrading to "
+                "attn_impl=ring (context-parallel flash over the sequence axis)",
+                stacklevel=2,
+            )
+            self.model.attn_impl = AttnImpl.RING.value
         if self.fl.client_count_scaling not in ("none", "linear", "sqrt"):
             raise ValueError(f"bad client_count_scaling {self.fl.client_count_scaling}")
         if self.model.resid_pdrop != 0.0:

@@ -172,6 +172,7 @@ class MPTBlock(nn.Module):
             q, k, v,
             impl=cfg.attn_impl, causal=True, alibi=cfg.alibi,
             block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+            interpret=cfg.attn_interpret,
         )
         attn_out = attn_out.reshape(b, s, cfg.d_model)
         x = x + dense(cfg.d_model, "out_proj", resid_std)(attn_out)

@@ -82,6 +82,7 @@ def multihead_attention(
     alibi: bool = False,
     block_q: int | None = None,
     block_k: int | None = None,
+    interpret: bool = False,
 ) -> jax.Array:
     """Dispatch on ``impl`` ∈ {pallas, xla, ring}. Falls back to XLA off-TPU;
     ``ring`` = context parallelism over the ambient mesh's ``sequence`` axis
@@ -108,12 +109,55 @@ def multihead_attention(
             pallas_supported,
         )
 
-        if pallas_supported(q):
-            return flash_attention(
-                q, k, v, causal=causal, alibi=alibi,
-                block_q=block_q or DEFAULT_BLOCK_Q,
-                block_k=block_k or DEFAULT_BLOCK_K,
+        if pallas_supported(q) or interpret:
+            bq = block_q or DEFAULT_BLOCK_Q
+            bk = block_k or DEFAULT_BLOCK_K
+
+            # Mosaic kernels cannot be auto-partitioned by GSPMD: on a
+            # multi-device mesh the pallas call must be wrapped in
+            # shard_map. Flash attention is independent per batch row and
+            # per head, so mapping over the batch (data+fsdp) and head
+            # (tensor) axes is exact — each shard runs the single-device
+            # kernel on its slice. Under a head-sharded (tensor>1) mesh,
+            # ALiBi slopes must come from the GLOBAL head index: each shard
+            # slices its rows out of the full slope table (the kernel's
+            # default would restart the slope sequence per shard).
+            from photon_tpu.parallel.context import current_mesh
+
+            mesh = current_mesh()
+            sharded_axes = [a for a in ("data", "fsdp", "tensor")
+                            if mesh is not None and mesh.shape.get(a, 1) > 1]
+            if not sharded_axes:
+                return flash_attention(q, k, v, causal=causal, alibi=alibi,
+                                       block_q=bq, block_k=bk,
+                                       interpret=interpret)
+
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            h_global = q.shape[2]
+            global_slopes = alibi_slopes(h_global) if alibi else None
+
+            def _local(q_s, k_s, v_s):
+                sl = None
+                if alibi:
+                    h_loc = q_s.shape[2]
+                    start = jax.lax.axis_index("tensor") * h_loc
+                    sl = jax.lax.dynamic_slice(global_slopes, (start,), (h_loc,))
+                return flash_attention(q_s, k_s, v_s, causal=causal,
+                                       alibi=alibi, alibi_slopes=sl,
+                                       block_q=bq, block_k=bk,
+                                       interpret=interpret)
+
+            spec = P(("data", "fsdp"), None, "tensor", None)
+            fn = shard_map(
+                _local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                # pallas_call emits un-annotated out-avals; varying-axis
+                # checking can't see through it (the map is exact anyway:
+                # one independent kernel instance per batch/head shard)
+                check_vma=False,
             )
+            return fn(q, k, v)
         impl = "xla"
     if impl != "xla":
         raise ValueError(f"unknown attention impl {impl!r}")

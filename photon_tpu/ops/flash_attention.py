@@ -73,12 +73,15 @@ def _alibi_bias(slope, q_blk, k_blk, block_q, block_k, offset) -> jax.Array:
     return -slope * (q_ids - k_ids).astype(jnp.float32)
 
 
-def _bh_slopes(alibi_h: int, bh: int) -> jax.Array:
+def _bh_slopes(h_slopes: jax.Array, bh: int) -> jax.Array:
     """[bh, SUBLANE, LANE] per-(batch*head) slope array (replicated across
-    the tile so each grid row DMAs one full fp32 tile)."""
-    from photon_tpu.ops.attention import alibi_slopes
-
-    slopes = jnp.tile(alibi_slopes(alibi_h), bh // alibi_h)  # head-major order
+    the tile so each grid row DMAs one full fp32 tile). ``h_slopes`` is the
+    per-head slope vector [h] — by default ``attention.alibi_slopes(h)``,
+    but a caller under a head-sharded (tensor-parallel) mesh passes its
+    LOCAL slice of the global slope table so every shard biases with its
+    true global head index."""
+    h = h_slopes.shape[0]
+    slopes = jnp.tile(h_slopes, bh // h)  # head-major order
     return jnp.broadcast_to(slopes[:, None, None], (bh, SUBLANE, LANE))
 
 
@@ -152,7 +155,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, block_q, block_k, causal, off
         lse_ref[0] = jnp.broadcast_to(lse[None, :], (SUBLANE, lse.shape[0]))
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_k, offset=None, alibi_h=0, interpret=False):
+def _fwd(q, k, v, *, scale, causal, block_q, block_k, offset=None, slopes=None,
+         interpret=False):
     bh, s_q, d = q.shape
     s_k = k.shape[1]
     n_q = pl.cdiv(s_q, block_q)
@@ -165,7 +169,7 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, offset=None, alibi_h=0, in
     offset = s_k - s_q if offset is None else offset
     kernel = functools.partial(
         _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k, causal=causal,
-        offset=offset, use_alibi=bool(alibi_h),
+        offset=offset, use_alibi=slopes is not None,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -173,9 +177,9 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, offset=None, alibi_h=0, in
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
     ]
     inputs = [q, k, v]
-    if alibi_h:
+    if slopes is not None:
         in_specs.append(pl.BlockSpec((1, SUBLANE, LANE), lambda b, i, j: (b, 0, 0)))
-        inputs.append(_bh_slopes(alibi_h, bh))
+        inputs.append(slopes)
     # lse carries SUBLANE redundant rows so its (1, 8, block_q) blocks are
     # exactly one fp32 tile; callers use row 0
     out_shape = [
@@ -311,7 +315,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest, scal
         dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, res, do, *, alibi_h=0, interpret=False):
+def _bwd(scale, causal, block_q, block_k, res, do, *, slopes=None, interpret=False):
     q, k, v, o, lse = res
     bh, s_q, d = q.shape
     s_k = k.shape[1]
@@ -323,8 +327,8 @@ def _bwd(scale, causal, block_q, block_k, res, do, *, alibi_h=0, interpret=False
     lse_b = jnp.broadcast_to(lse[:, None, :], (bh, SUBLANE, s_q))
     delta_b = jnp.broadcast_to(delta[:, None, :], (bh, SUBLANE, s_q))
 
-    use_alibi = bool(alibi_h)
-    extra_inputs = [_bh_slopes(alibi_h, bh)] if use_alibi else []
+    use_alibi = slopes is not None
+    extra_inputs = [slopes] if use_alibi else []
     slope_spec = (
         [pl.BlockSpec((1, SUBLANE, LANE), lambda b, i, j: (b, 0, 0))] if use_alibi else []
     )
@@ -382,21 +386,28 @@ def _bwd(scale, causal, block_q, block_k, res, do, *, alibi_h=0, interpret=False
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, scale, causal, block_q, block_k, alibi_h, interpret):
+# slopes rides as a real operand (index 3) so a tensor-parallel caller can
+# pass per-shard slope slices (traced values — a static head count cannot
+# express a shard-dependent offset); its cotangent is zero (slopes are
+# non-learned constants)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, slopes, scale, causal, block_q, block_k, interpret):
     o, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-                alibi_h=alibi_h, interpret=interpret)
+                slopes=slopes, interpret=interpret)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, alibi_h, interpret):
+def _flash_fwd(q, k, v, slopes, scale, causal, block_q, block_k, interpret):
     o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-                  alibi_h=alibi_h, interpret=interpret)
-    return o, (q, k, v, o, lse)
+                  slopes=slopes, interpret=interpret)
+    return o, (q, k, v, o, lse, slopes)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, alibi_h, interpret, res, do):
-    return _bwd(scale, causal, block_q, block_k, res, do, alibi_h=alibi_h, interpret=interpret)
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse, slopes = res
+    dq, dk, dv = _bwd(scale, causal, block_q, block_k, (q, k, v, o, lse), do,
+                      slopes=slopes, interpret=interpret)
+    return dq, dk, dv, jax.tree.map(jnp.zeros_like, slopes)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -409,15 +420,20 @@ def flash_attention(
     *,
     causal: bool = True,
     alibi: bool = False,
+    alibi_slopes: jax.Array | None = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash attention over ``[batch, seq, heads, d_head]`` inputs.
 
-    ``alibi`` adds the per-head linear distance bias in-kernel (slopes are
-    static per head count — ``ops/attention.py:alibi_slopes``).
-    ``interpret`` runs the kernel in the Pallas interpreter (CPU-testable)."""
+    ``alibi`` adds the per-head linear distance bias in-kernel. Slopes
+    default to ``ops/attention.py:alibi_slopes(h)``; a head-sharded
+    (tensor-parallel) caller passes ``alibi_slopes`` — its LOCAL [h] slice
+    of the global slope table — so each shard biases with its true global
+    head index (the in-kernel default would restart the slope sequence per
+    shard). ``interpret`` runs the kernel in the Pallas interpreter
+    (CPU-testable)."""
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     block_q = min(block_q, s_q)
@@ -435,7 +451,13 @@ def flash_attention(
         return x
 
     qb, kb, vb = to_bh(q, s_q), to_bh(k, s_k), to_bh(v, s_k)
-    ob = _flash(qb, kb, vb, scale, causal, block_q, block_k, h if alibi else 0, interpret)
+    slopes = None
+    if alibi:
+        from photon_tpu.ops.attention import alibi_slopes as default_slopes
+
+        h_slopes = alibi_slopes if alibi_slopes is not None else default_slopes(h)
+        slopes = _bh_slopes(h_slopes.astype(jnp.float32), b * h)
+    ob = _flash(qb, kb, vb, slopes, scale, causal, block_q, block_k, interpret)
     o = ob[..., :d].reshape(b, h, s_q, d)
     return jnp.transpose(o, (0, 2, 1, 3))
 

@@ -9,9 +9,17 @@ in-image ``libtpu.so``, so a hang/crash can be reproduced, bisected, and fixed
 entirely offline — and a clean run gives the true compile cost plus an AOT
 memory/FLOPs analysis for any config.
 
+Also compiles the SHARDED multi-chip step against a real multi-device TPU
+topology (``--mesh fsdp=4`` over ``--topo v5e:2x2x1``): the Mosaic/XLA:TPU
+compiler lays out the actual ICI collectives and reports per-device HBM —
+much stronger evidence for the sharding design than the virtual-CPU-device
+dryrun, and obtainable with zero chips.
+
 Usage:
     python scripts/aot_compile_check.py [--micro 2] [--gbs 256] [--impl pallas]
         [--block 256] [--chunk 2048] [--remat] [--layers N] [--seq N]
+        [--preset mpt-1b] [--mesh data=1,fsdp=4,tensor=1,sequence=1]
+        [--topo v5e:2x2x1]
 
 Prints one JSON line: {"ok", "lower_s", "compile_s", "hbm_gib", ...}.
 """
@@ -57,10 +65,14 @@ def main() -> int:
     ap.add_argument("--layers", type=int, default=0, help="override n_layers")
     ap.add_argument("--seq", type=int, default=0, help="override max_seq_len")
     ap.add_argument("--preset", default="", help="config preset name (default: 125M recipe)")
+    ap.add_argument("--mesh", default="", help="axis sizes, e.g. 'fsdp=4' or "
+                    "'data=2,fsdp=2' (unnamed axes default to 1)")
+    ap.add_argument("--topo", default="v5e:2x2x1",
+                    help="TPU topology to compile against")
     args = ap.parse_args()
 
     from jax.experimental import topologies
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    from jax.sharding import NamedSharding
 
     from photon_tpu.config import load_preset
     from photon_tpu.config.schema import Config
@@ -90,31 +102,65 @@ def main() -> int:
     cfg.train.loss_chunk_tokens = args.chunk
     cfg.validate()
 
-    topo = topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x2x1")
+    # topology shape drives libtpu's TPU_TOPOLOGY check; accelerator type
+    # stays v5litepod-4 (sets the 2x2 host bounds every shape must divide).
+    # v5e is a 2D generation: a trailing literal x1 dimension is sugar
+    # ("2x4x1" == "2x4") — strip exactly that, never a substring
+    shape = args.topo.split(":", 1)[1]
+    parts = shape.split("x")
+    if args.topo.startswith("v5e:") and len(parts) == 3 and parts[2] == "1":
+        shape = "x".join(parts[:2])
+    os.environ["TPU_TOPOLOGY"] = shape
+    topo = topologies.get_topology_desc(platform="tpu", topology_name=args.topo)
     dev = topo.devices[0]
-    log(f"abstract device: {dev.device_kind}")
-    mesh = Mesh(np.array(topo.devices[:1]), ("d",))
-    repl = NamedSharding(mesh, PartitionSpec())
+    log(f"abstract device: {dev.device_kind} x{len(topo.devices)}")
+
+    from photon_tpu.config.schema import MeshConfig
+    from photon_tpu.parallel.context import use_mesh
+    from photon_tpu.parallel.mesh import make_mesh
+    from photon_tpu.parallel.sharding import batch_spec, state_shardings
+
+    axes = {"data": 1, "fsdp": 1, "tensor": 1, "sequence": 1}
+    if args.mesh:
+        for kv in args.mesh.split(","):
+            k, _, v = kv.partition("=")
+            if k.strip() not in axes:
+                raise SystemExit(f"unknown mesh axis {k!r}")
+            axes[k.strip()] = int(v)
+    mesh_cfg = MeshConfig(**axes)
+    cfg.mesh = mesh_cfg
+    cfg.validate()  # re-validate with the mesh (e.g. pallas→ring upgrade)
+    mesh = make_mesh(mesh_cfg, devices=list(topo.devices))
 
     model = MPTModel(cfg.model)
     tx, _ = build_optimizer(cfg.optimizer, cfg.scheduler)
     params = jax.eval_shape(lambda: init_params(cfg.model, seed=0))
     state = jax.eval_shape(lambda p: init_train_state(model, tx, p), params)
+    shardings = state_shardings(state, mesh)
     state = jax.tree.map(
-        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=repl), state
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        state, shardings,
     )
     tok = jax.ShapeDtypeStruct(
-        (args.gbs, cfg.model.max_seq_len), jax.numpy.int32, sharding=repl
+        (args.gbs, cfg.model.max_seq_len), jax.numpy.int32,
+        sharding=NamedSharding(mesh, batch_spec(mesh)),
     )
+    # trainer semantics (trainer.py rows_per_scan): each scan step consumes
+    # micro rows PER data-parallel shard
+    dp_degree = axes["data"] * axes["fsdp"]
+    rows_per_scan = args.micro * dp_degree
+    if args.gbs % rows_per_scan:
+        raise SystemExit(f"gbs {args.gbs} not divisible by micro*dp "
+                         f"({args.micro}*{dp_degree})")
     step = make_train_step(
-        model, tx, n_microbatches=args.gbs // args.micro,
+        model, tx, n_microbatches=args.gbs // rows_per_scan,
         loss_chunk_tokens=args.chunk,
     )
 
     from photon_tpu.utils.heartbeat import heartbeat
 
     t0 = time.perf_counter()
-    with heartbeat("[aot] still compiling"):
+    with heartbeat("[aot] still compiling"), use_mesh(mesh):
         lowered = jax.jit(step, donate_argnums=0).lower(state, tok)
         t1 = time.perf_counter()
         log(f"lowered in {t1 - t0:.1f}s")
@@ -124,6 +170,10 @@ def main() -> int:
 
     out = {
         "ok": True,
+        "preset": args.preset or "125m-default",
+        "topo": args.topo,
+        "mesh": {k: v for k, v in axes.items() if v > 1} or None,
+        "n_devices": len(topo.devices),
         "impl": args.impl,
         "block": args.block or cfg.model.flash_block_q,
         "chunk": args.chunk,

@@ -1,0 +1,100 @@
+"""Pallas flash attention on a multi-device mesh (round-5 fix).
+
+Mosaic kernels cannot be auto-partitioned by GSPMD — `multihead_attention`
+must wrap the pallas call in `shard_map` on a sharded mesh (exact: the
+kernel is independent per batch row and per head). Discovered by the
+offline sharded AOT compile (`scripts/aot_compile_check.py --mesh fsdp=4`),
+which raised `NotImplementedError: Mosaic kernels cannot be automatically
+partitioned` on the pre-fix dispatch; never caught before because off-TPU
+the dispatch silently falls back to XLA attention.
+
+Runs the kernel in interpret mode on the conftest's 8 virtual CPU devices;
+the reference is the plain XLA attention on the same global inputs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.config.schema import MeshConfig
+from photon_tpu.ops import attention as attn_mod
+from photon_tpu.ops.attention import multihead_attention, xla_attention
+from photon_tpu.parallel.context import use_mesh
+from photon_tpu.parallel.mesh import make_mesh
+
+B, S, H, D = 4, 256, 4, 64
+
+
+@pytest.fixture()
+def qkv():
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def _mesh(**axes):
+    return make_mesh(MeshConfig(**axes))
+
+
+@pytest.mark.parametrize("axes", [
+    {"data": 2, "fsdp": 2},              # batch sharded two ways
+    {"data": 2, "fsdp": 2, "tensor": 2},  # batch + head sharded
+])
+def test_sharded_flash_matches_xla(qkv, axes, monkeypatch):
+    q, k, v = qkv
+    ref = xla_attention(q, k, v, causal=True, alibi=False)
+    monkeypatch.setattr(attn_mod, "xla_attention", None)  # must not be used
+    with use_mesh(_mesh(**axes)):
+        out = multihead_attention(q, k, v, impl="pallas", causal=True,
+                                  alibi=False, block_q=128, block_k=128,
+                                  interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_sharded_flash_alibi_batch_axes_only(qkv, monkeypatch):
+    # ALiBi is safe under batch sharding (head dim unsharded)
+    q, k, v = qkv
+    ref = xla_attention(q, k, v, causal=True, alibi=True)
+    with use_mesh(_mesh(data=2, fsdp=2)):
+        out = multihead_attention(q, k, v, impl="pallas", causal=True,
+                                  alibi=True, block_q=128, block_k=128,
+                                  interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_alibi_with_tensor_sharding_uses_global_slopes(qkv):
+    # in-kernel ALiBi derives slopes from the head index; under a
+    # head-sharded mesh each shard must slice ITS rows of the global slope
+    # table (a per-shard restart of the slope sequence would silently bias
+    # heads wrong — only a global-reference comparison catches it)
+    q, k, v = qkv
+    ref = xla_attention(q, k, v, causal=True, alibi=True)
+    with use_mesh(_mesh(data=2, tensor=2)):
+        out = multihead_attention(q, k, v, impl="pallas", causal=True,
+                                  alibi=True, block_q=128, block_k=128,
+                                  interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_sharded_flash_gradients_match_xla(qkv):
+    q, k, v = qkv
+
+    def loss_flash(q, k, v):
+        with use_mesh(_mesh(data=2, fsdp=2)):
+            o = multihead_attention(q, k, v, impl="pallas", causal=True,
+                                    alibi=False, block_q=128, block_k=128,
+                                    interpret=True)
+        return jnp.sum(o * o)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(xla_attention(q, k, v, causal=True, alibi=False)))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-2, rtol=5e-2)
