@@ -69,7 +69,18 @@ def main() -> int:
                     "'data=2,fsdp=2' (unnamed axes default to 1)")
     ap.add_argument("--topo", default="v5e:2x2x1",
                     help="TPU topology to compile against")
+    ap.add_argument("--program", default="train", choices=["train", "decode"],
+                    help="train = the jitted train step; decode = the "
+                    "KV-cache prefill + per-token decode_step pair the "
+                    "gauntlet's generation scorer compiles on-chip")
+    ap.add_argument("--batch", type=int, default=8, help="decode batch rows")
     args = ap.parse_args()
+    if ":" not in args.topo:
+        ap.error(f"--topo must look like 'v5e:2x2x1', got {args.topo!r}")
+    if args.program == "decode" and args.mesh:
+        # the gauntlet's inference pair runs single-chip; compiling it
+        # sharded would report numbers for a program the stage never builds
+        ap.error("--program decode is single-device; drop --mesh")
 
     from jax.experimental import topologies
     from jax.sharding import NamedSharding
@@ -131,6 +142,9 @@ def main() -> int:
     cfg.mesh = mesh_cfg
     cfg.validate()  # re-validate with the mesh (e.g. pallas→ring upgrade)
     mesh = make_mesh(mesh_cfg, devices=list(topo.devices))
+
+    if args.program == "decode":
+        return _compile_decode(args, cfg, topo, dev)
 
     model = MPTModel(cfg.model)
     tx, _ = build_optimizer(cfg.optimizer, cfg.scheduler)
@@ -196,6 +210,79 @@ def main() -> int:
         out["hbm_gib"] = None
         log(f"memory_analysis unavailable: {e}")
     print(json.dumps(out), flush=True)
+    return 0
+
+
+def _compile_decode(args, cfg, topo, dev) -> int:
+    """Compile the gauntlet's inference pair (prefill + decode_step) for
+    the TPU topology — the on-chip gauntlet stage compiles exactly these
+    jits (models/decode.py:make_cached_generate_fn), so verifying them
+    offline de-risks GAUNTLET_TPU.json the same way the train-step matrix
+    de-risks the headline bench."""
+    import jax.numpy as jnp
+
+    from jax.sharding import NamedSharding, PartitionSpec
+    from photon_tpu.models import init_params
+    from photon_tpu.models.decode import DecodeState, decode_step, prefill
+    from photon_tpu.utils.heartbeat import heartbeat
+
+    mcfg = cfg.model
+    b, s = args.batch, mcfg.max_seq_len
+    n_kv = mcfg.n_kv_heads or mcfg.n_heads
+    # decode consumes the stacked-layer param tree exactly as trained
+    params = jax.eval_shape(lambda: init_params(mcfg, seed=0))
+    from jax.sharding import Mesh
+
+    mesh1 = Mesh(np.asarray(topo.devices[:1]), ("d",))
+    repl = NamedSharding(mesh1, PartitionSpec())
+    as_abstract = lambda t: jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=repl), t)
+    params = as_abstract(params)
+    tokens = jax.ShapeDtypeStruct((b, s), jnp.int32, sharding=repl)
+    lengths = jax.ShapeDtypeStruct((b,), jnp.int32, sharding=repl)
+    cache_dtype = jnp.dtype(mcfg.compute_dtype)
+    state = DecodeState(
+        cache_k=jax.ShapeDtypeStruct(
+            (mcfg.n_layers, b, s, n_kv, mcfg.d_head), cache_dtype, sharding=repl),
+        cache_v=jax.ShapeDtypeStruct(
+            (mcfg.n_layers, b, s, n_kv, mcfg.d_head), cache_dtype, sharding=repl),
+        lengths=lengths,
+    )
+    token = jax.ShapeDtypeStruct((b,), jnp.int32, sharding=repl)
+
+    t0 = time.perf_counter()
+    with heartbeat("[aot] still compiling"):
+        pre = jax.jit(lambda p, t, l: prefill(p, t, l, mcfg))
+        pre_c = pre.lower(params, tokens, lengths).compile()
+        t1 = time.perf_counter()
+        step = jax.jit(lambda p, st, tok: decode_step(p, st, tok, mcfg),
+                       donate_argnums=1)
+        step_c = step.lower(params, state, token).compile()
+    t2 = time.perf_counter()
+
+    def _mem(compiled):
+        try:
+            ma = compiled.memory_analysis()
+            return round((ma.argument_size_in_bytes + ma.output_size_in_bytes
+                          + ma.temp_size_in_bytes) / 2**30, 2)
+        except Exception:  # noqa: BLE001
+            return None
+
+    print(json.dumps({
+        "ok": True,
+        "program": "decode",
+        "preset": args.preset or "125m-default",
+        "topo": args.topo,
+        "mesh": None,  # inference pair is single-device (see ap.error above)
+        "batch": b,
+        "seq": s,
+        "impl": mcfg.attn_impl,
+        "prefill_compile_s": round(t1 - t0, 1),
+        "decode_step_compile_s": round(t2 - t1, 1),
+        "prefill_hbm_gib": _mem(pre_c),
+        "decode_step_hbm_gib": _mem(step_c),
+        "device_kind": dev.device_kind,
+    }), flush=True)
     return 0
 
 
