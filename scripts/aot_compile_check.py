@@ -69,8 +69,9 @@ def main() -> int:
                     "'data=2,fsdp=2' (unnamed axes default to 1)")
     ap.add_argument("--topo", default="v5e:2x2x1",
                     help="TPU topology to compile against")
-    ap.add_argument("--program", default="train", choices=["train", "decode"],
-                    help="train = the jitted train step; decode = the "
+    ap.add_argument("--program", default="train", choices=["train", "eval", "decode"],
+                    help="train = the jitted train step; eval = the chunked "
+                    "eval step (convergence-stage val pass); decode = the "
                     "KV-cache prefill + per-token decode_step pair the "
                     "gauntlet's generation scorer compiles on-chip")
     ap.add_argument("--batch", type=int, default=8, help="decode batch rows")
@@ -108,7 +109,9 @@ def main() -> int:
         cfg.model.n_layers = args.layers
     if args.seq:
         cfg.model.max_seq_len = args.seq
-    cfg.train.device_microbatch_size = args.micro
+    # eval/decode have no microbatch scan — keep config validation happy
+    cfg.train.device_microbatch_size = args.micro if args.program == "train" \
+        else args.gbs
     cfg.train.global_batch_size = args.gbs
     cfg.train.loss_chunk_tokens = args.chunk
     cfg.validate()
@@ -160,22 +163,33 @@ def main() -> int:
         sharding=NamedSharding(mesh, batch_spec(mesh)),
     )
     # trainer semantics (trainer.py rows_per_scan): each scan step consumes
-    # micro rows PER data-parallel shard
+    # micro rows PER data-parallel shard. Eval has no microbatch scan — it
+    # only needs the batch to split over the data-parallel shards.
     dp_degree = axes["data"] * axes["fsdp"]
-    rows_per_scan = args.micro * dp_degree
+    rows_per_scan = args.micro * dp_degree if args.program == "train" else dp_degree
     if args.gbs % rows_per_scan:
-        raise SystemExit(f"gbs {args.gbs} not divisible by micro*dp "
-                         f"({args.micro}*{dp_degree})")
-    step = make_train_step(
-        model, tx, n_microbatches=args.gbs // rows_per_scan,
-        loss_chunk_tokens=args.chunk,
-    )
+        raise SystemExit(f"gbs {args.gbs} not divisible by "
+                         f"{'micro*dp' if args.program == 'train' else 'dp'} "
+                         f"({rows_per_scan})")
+    if args.program == "eval":
+        from photon_tpu.train.train_step import make_eval_step
+
+        step = make_eval_step(model, loss_chunk_tokens=args.chunk)
+        jitted = jax.jit(step)
+        jit_args = (state.params, tok)
+    else:
+        step = make_train_step(
+            model, tx, n_microbatches=args.gbs // rows_per_scan,
+            loss_chunk_tokens=args.chunk,
+        )
+        jitted = jax.jit(step, donate_argnums=0)
+        jit_args = (state, tok)
 
     from photon_tpu.utils.heartbeat import heartbeat
 
     t0 = time.perf_counter()
     with heartbeat("[aot] still compiling"), use_mesh(mesh):
-        lowered = jax.jit(step, donate_argnums=0).lower(state, tok)
+        lowered = jitted.lower(*jit_args)
         t1 = time.perf_counter()
         log(f"lowered in {t1 - t0:.1f}s")
         compiled = lowered.compile()
@@ -184,6 +198,7 @@ def main() -> int:
 
     out = {
         "ok": True,
+        "program": args.program,
         "preset": args.preset or "125m-default",
         "topo": args.topo,
         "mesh": {k: v for k, v in axes.items() if v > 1} or None,
