@@ -143,16 +143,12 @@ def prefill(params: dict, tokens: jax.Array, lengths: jax.Array,
         if cfg.rope:
             q = _rope_at(q, pos, cfg.rope_theta)
             k = _rope_at(k, pos, cfg.rope_theta)
-        if n_kv != cfg.n_heads:
-            rep = cfg.n_heads // n_kv
-            kf, vf = jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
-        else:
-            kf, vf = k, v
         # dispatch on the config's impl (pallas on chip) so prefill numerics
         # match the training/logprob forward; ring is a mesh-training
-        # construct — decode is single-host, so it degrades to the fallback
+        # construct — decode is single-host, so it degrades to the fallback.
+        # k/v stay at n_kv width: the dispatch handles GQA natively
         attn = multihead_attention(
-            q, kf, vf,
+            q, k, v,
             impl=cfg.attn_impl if cfg.attn_impl != "ring" else "xla",
             causal=True, alibi=cfg.alibi,
             block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,

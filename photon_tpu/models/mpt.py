@@ -159,15 +159,10 @@ class MPTBlock(nn.Module):
             # before the kv repeat: the rotation is per-head-identical, so
             # rotating n_kv heads then replicating equals the reverse order
             q, k = apply_rope(q, k, cfg.rope_theta)
-        if n_kv != cfg.n_heads:
-            # replicate kv groups up to n_heads ahead of the kernels. This
-            # keeps one kernel for MHA/GQA at the cost of materializing
-            # full-width kv activations: the projection-weight saving
-            # survives; the kv HBM/ring-transfer saving would need
-            # GQA-aware flash/ring kernels (future work)
-            rep = cfg.n_heads // n_kv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        # k/v go to the dispatch at their native n_kv width: the pallas
+        # flash kernel consumes GQA groups directly (index-mapped kv rows,
+        # no repeated tensor in HBM); the xla/ring paths replicate inside
+        # ops/attention.py
         attn_out = multihead_attention(
             q, k, v,
             impl=cfg.attn_impl, causal=True, alibi=cfg.alibi,

@@ -90,7 +90,19 @@ def multihead_attention(
     axis is trivial. ALiBi runs in-kernel on the pallas path (per-head slope
     bias, ``flash_attention.py:_alibi_bias``); the ring path's pallas inner
     kernel still degrades to XLA under alibi (the lse-merge bwd oracle does
-    not model the bias yet)."""
+    not model the bias yet).
+
+    Grouped-query attention: ``k``/``v`` may carry fewer heads than ``q``.
+    The pallas kernel consumes them natively (index-mapped kv groups, no
+    repeated-kv tensor in HBM); the xla and ring paths replicate kv up to
+    the q head count here, at the dispatch, so model code never has to."""
+    h_q, h_kv = q.shape[2], k.shape[2]
+    if h_q % h_kv:
+        raise ValueError(f"q heads ({h_q}) must be a multiple of kv heads ({h_kv})")
+
+    def rep(x):
+        return jnp.repeat(x, h_q // h_kv, axis=2) if h_kv != h_q else x
+
     if impl == "ring":
         from photon_tpu.ops.flash_attention import pallas_supported
         from photon_tpu.ops.ring_attention import ring_attention
@@ -99,7 +111,8 @@ def multihead_attention(
         mesh = current_mesh()
         inner = "pallas" if (pallas_supported(q) and not alibi) else "xla"
         if mesh is not None and mesh.shape.get("sequence", 1) > 1:
-            return ring_attention(q, k, v, mesh, causal=causal, impl=inner, alibi=alibi)
+            return ring_attention(q, rep(k), rep(v), mesh, causal=causal,
+                                  impl=inner, alibi=alibi)
         impl = inner
     if impl == "pallas":
         from photon_tpu.ops.flash_attention import (
@@ -131,6 +144,11 @@ def multihead_attention(
                 return flash_attention(q, k, v, causal=causal, alibi=alibi,
                                        block_q=bq, block_k=bk,
                                        interpret=interpret)
+            if h_kv % mesh.shape.get("tensor", 1):
+                # kv heads don't split over the tensor axis — replicate up
+                # to the q head count (which always splits; param_specs
+                # shards q by tensor)
+                k, v = rep(k), rep(v)
 
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
@@ -161,4 +179,4 @@ def multihead_attention(
         impl = "xla"
     if impl != "xla":
         raise ValueError(f"unknown attention impl {impl!r}")
-    return xla_attention(q, k, v, causal=causal, alibi=alibi)
+    return xla_attention(q, rep(k), rep(v), causal=causal, alibi=alibi)

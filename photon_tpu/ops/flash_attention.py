@@ -155,13 +155,31 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, block_q, block_k, causal, off
         lse_ref[0] = jnp.broadcast_to(lse[None, :], (SUBLANE, lse.shape[0]))
 
 
+def _kv_row(h_q: int, h_kv: int):
+    """bh_q-major grid row → k/v storage row for grouped-query attention.
+
+    Arrays are head-major flattened (``b*h + h_idx``); q head ``hq`` reads
+    kv head ``hq // group``. With ``h_q == h_kv`` (MHA) this is identity.
+    """
+    group = h_q // h_kv
+
+    def row(bh):
+        if group == 1:
+            return bh
+        return (bh // h_q) * h_kv + (bh % h_q) // group
+
+    return row
+
+
 def _fwd(q, k, v, *, scale, causal, block_q, block_k, offset=None, slopes=None,
-         interpret=False):
+         h_q=0, interpret=False):
     bh, s_q, d = q.shape
     s_k = k.shape[1]
     n_q = pl.cdiv(s_q, block_q)
     n_k = pl.cdiv(s_k, block_k)
     grid = (bh, n_q, n_k)
+    h_q = h_q or 1  # 0 → MHA (kv row == q row; exact head split irrelevant)
+    kv = _kv_row(h_q, h_q * k.shape[0] // bh)
 
     # offset generalizes the causal mask to chunked/global positions:
     # visible iff q_id + offset >= k_id (ring attention passes
@@ -173,8 +191,8 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, offset=None, slopes=None,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv(b), j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv(b), j, 0)),
     ]
     inputs = [q, k, v]
     if slopes is not None:
@@ -259,17 +277,22 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest, scale
         dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest, scale, block_q, block_k, causal, offset, use_alibi):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest, scale, block_q, block_k, causal, offset, use_alibi, n_q):
+    """Inner grid dim sweeps ``group * n_q`` steps: for grouped-query
+    attention every kv row accumulates dk/dv over ALL q heads of its group
+    (t // n_q picks the group member, t % n_q the q block); MHA is the
+    group == 1 degenerate case."""
     if use_alibi:
         slopes_ref, dk_ref, dv_ref, dk_s, dv_s = rest
     else:
         slopes_ref = None
         dk_ref, dv_ref, dk_s, dv_s = rest
     k_blk = pl.program_id(1)
-    q_blk = pl.program_id(2)
-    n_q = pl.num_programs(2)
+    t = pl.program_id(2)
+    n_t = pl.num_programs(2)
+    q_blk = t % n_q
 
-    @pl.when(q_blk == 0)
+    @pl.when(t == 0)
     def _init():
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
@@ -309,18 +332,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest, scal
     else:
         _compute()
 
-    @pl.when(q_blk == n_q - 1)
+    @pl.when(t == n_t - 1)
     def _finalize():
         dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, res, do, *, slopes=None, interpret=False):
+def _bwd(scale, causal, block_q, block_k, res, do, *, slopes=None, h_q=0,
+         interpret=False):
     q, k, v, o, lse = res
     bh, s_q, d = q.shape
     s_k = k.shape[1]
     n_q = pl.cdiv(s_q, block_q)
     n_k = pl.cdiv(s_k, block_k)
+    bh_k = k.shape[0]
+    h_q = h_q or 1
+    h_kv = h_q * bh_k // bh
+    group = h_q // h_kv
+    kv = _kv_row(h_q, h_kv)
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [bh, s_q]
     # SUBLANE-replicated rows for TPU tiling (see _fwd)
@@ -339,8 +368,8 @@ def _bwd(scale, causal, block_q, block_k, res, do, *, slopes=None, interpret=Fal
         grid=(bh, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # q
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # k
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # v
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv(b), j, 0)),  # k
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv(b), j, 0)),  # v
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # do
             pl.BlockSpec((1, SUBLANE, block_q), lambda b, i, j: (b, 0, i)),  # lse
             pl.BlockSpec((1, SUBLANE, block_q), lambda b, i, j: (b, 0, i)),  # delta
@@ -351,29 +380,41 @@ def _bwd(scale, causal, block_q, block_k, res, do, *, slopes=None, interpret=Fal
         interpret=interpret,
     )(q, k, v, do, lse_b, delta_b, *extra_inputs)
 
+    # dkv grid rows are the kv STORAGE rows; the inner dim sweeps the
+    # group's q heads × q blocks so each kv row accumulates its whole
+    # gradient in one VMEM scratch pass (GQA-native: no repeated kv, no
+    # cross-row reduction)
+    def qrow(b, t):
+        if group == 1:
+            return b
+        return (b // h_kv) * h_q + (b % h_kv) * group + t // n_q
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
-                          causal=causal, offset=s_k - s_q, use_alibi=use_alibi),
-        grid=(bh, n_k, n_q),
+                          causal=causal, offset=s_k - s_q, use_alibi=use_alibi, n_q=n_q),
+        grid=(bh_k, n_k, group * n_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # q
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # k
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # v
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # do
-            pl.BlockSpec((1, SUBLANE, block_q), lambda b, j, i: (b, 0, i)),  # lse
-            pl.BlockSpec((1, SUBLANE, block_q), lambda b, j, i: (b, 0, i)),  # delta
-        ] + slope_spec,
+            pl.BlockSpec((1, block_q, d), lambda b, j, t: (qrow(b, t), t % n_q, 0)),  # q
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),  # k
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),  # v
+            pl.BlockSpec((1, block_q, d), lambda b, j, t: (qrow(b, t), t % n_q, 0)),  # do
+            pl.BlockSpec((1, SUBLANE, block_q), lambda b, j, t: (qrow(b, t), 0, t % n_q)),  # lse
+            pl.BlockSpec((1, SUBLANE, block_q), lambda b, j, t: (qrow(b, t), 0, t % n_q)),  # delta
+        ] + (
+            [pl.BlockSpec((1, SUBLANE, LANE), lambda b, j, t: (qrow(b, t), 0, 0))]
+            if use_alibi else []
+        ),
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_k, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s_k, d), v.dtype),
+            jax.ShapeDtypeStruct((bh_k, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh_k, s_k, d), v.dtype),
         ],
         interpret=interpret,
     )(q, k, v, do, lse_b, delta_b, *extra_inputs)
@@ -389,24 +430,25 @@ def _bwd(scale, causal, block_q, block_k, res, do, *, slopes=None, interpret=Fal
 # slopes rides as a real operand (index 3) so a tensor-parallel caller can
 # pass per-shard slope slices (traced values — a static head count cannot
 # express a shard-dependent offset); its cotangent is zero (slopes are
-# non-learned constants)
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, slopes, scale, causal, block_q, block_k, interpret):
+# non-learned constants). ``h_q`` (static) carries the q-head count for
+# grouped-query attention, where k/v hold fewer rows than q; 0 = MHA.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, slopes, scale, causal, block_q, block_k, interpret, h_q=0):
     o, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-                slopes=slopes, interpret=interpret)
+                slopes=slopes, h_q=h_q, interpret=interpret)
     return o
 
 
-def _flash_fwd(q, k, v, slopes, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, slopes, scale, causal, block_q, block_k, interpret, h_q=0):
     o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-                  slopes=slopes, interpret=interpret)
+                  slopes=slopes, h_q=h_q, interpret=interpret)
     return o, (q, k, v, o, lse, slopes)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+def _flash_bwd(scale, causal, block_q, block_k, interpret, h_q, res, do):
     q, k, v, o, lse, slopes = res
     dq, dk, dv = _bwd(scale, causal, block_q, block_k, (q, k, v, o, lse), do,
-                      slopes=slopes, interpret=interpret)
+                      slopes=slopes, h_q=h_q, interpret=interpret)
     return dq, dk, dv, jax.tree.map(jnp.zeros_like, slopes)
 
 
@@ -427,6 +469,11 @@ def flash_attention(
 ) -> jax.Array:
     """Flash attention over ``[batch, seq, heads, d_head]`` inputs.
 
+    Grouped-query attention is native: ``k``/``v`` may carry fewer heads
+    than ``q`` (``h_q % h_kv == 0``) — the kernel index-maps each q head
+    onto its kv group row, so the repeated-kv tensor is never materialized
+    in HBM (fwd reads and bwd dk/dv are kv-row-major).
+
     ``alibi`` adds the per-head linear distance bias in-kernel. Slopes
     default to ``ops/attention.py:alibi_slopes(h)``; a head-sharded
     (tensor-parallel) caller passes ``alibi_slopes`` — its LOCAL [h] slice
@@ -435,6 +482,13 @@ def flash_attention(
     shard). ``interpret`` runs the kernel in the Pallas interpreter
     (CPU-testable)."""
     b, s_q, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads ({h}) must be a multiple of kv heads ({h_kv})")
+    if v.shape[2] != h_kv:
+        # the kv row map is derived from k's width and applied to v — a
+        # mismatch would silently read the wrong heads
+        raise ValueError(f"k has {h_kv} heads but v has {v.shape[2]}")
     s_k = k.shape[1]
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)
@@ -444,20 +498,21 @@ def flash_attention(
 
     d_pad = max(LANE, ((d + LANE - 1) // LANE) * LANE)
 
-    def to_bh(x, s):
-        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+    def to_bh(x, s, heads):
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * heads, s, d)
         if d_pad != d:
             x = jnp.pad(x, ((0, 0), (0, 0), (0, d_pad - d)))
         return x
 
-    qb, kb, vb = to_bh(q, s_q), to_bh(k, s_k), to_bh(v, s_k)
+    qb, kb, vb = to_bh(q, s_q, h), to_bh(k, s_k, h_kv), to_bh(v, s_k, h_kv)
     slopes = None
     if alibi:
         from photon_tpu.ops.attention import alibi_slopes as default_slopes
 
         h_slopes = alibi_slopes if alibi_slopes is not None else default_slopes(h)
         slopes = _bh_slopes(h_slopes.astype(jnp.float32), b * h)
-    ob = _flash(qb, kb, vb, slopes, scale, causal, block_q, block_k, interpret)
+    ob = _flash(qb, kb, vb, slopes, scale, causal, block_q, block_k, interpret,
+                h if h_kv != h else 0)
     o = ob[..., :d].reshape(b, h, s_q, d)
     return jnp.transpose(o, (0, 2, 1, 3))
 
