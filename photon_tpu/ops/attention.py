@@ -111,7 +111,15 @@ def multihead_attention(
         mesh = current_mesh()
         inner = "pallas" if (pallas_supported(q) and not alibi) else "xla"
         if mesh is not None and mesh.shape.get("sequence", 1) > 1:
-            return ring_attention(q, rep(k), rep(v), mesh, causal=causal,
+            # GQA kv rides the ring at native width (group× less ppermute
+            # traffic); ring_attention handles the groups in its chunk
+            # kernel. Exception: kv heads that don't split over the tensor
+            # axis would silently drop head sharding inside ring_attention
+            # (its spec falls back to replicated heads) — replicate kv up to
+            # the q head count instead, like the non-ring pallas path
+            if h_kv % mesh.shape.get("tensor", 1):
+                k, v = rep(k), rep(v)
+            return ring_attention(q, k, v, mesh, causal=causal,
                                   impl=inner, alibi=alibi)
         impl = inner
     if impl == "pallas":

@@ -522,19 +522,21 @@ def flash_attention(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_lse(q, k, v, scale, causal, offset, block_q, block_k, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_lse(q, k, v, scale, causal, offset, block_q, block_k, interpret=False,
+               h_q=0):
     return _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-                offset=offset, interpret=interpret)
+                offset=offset, h_q=h_q, interpret=interpret)
 
 
-def _flash_lse_fwd(q, k, v, scale, causal, offset, block_q, block_k, interpret=False):
+def _flash_lse_fwd(q, k, v, scale, causal, offset, block_q, block_k, interpret=False,
+                   h_q=0):
     o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-                  offset=offset, interpret=interpret)
+                  offset=offset, h_q=h_q, interpret=interpret)
     return (o, lse), (q, k, v)
 
 
-def _flash_lse_bwd(scale, causal, offset, block_q, block_k, interpret, res, cots):
+def _flash_lse_bwd(scale, causal, offset, block_q, block_k, interpret, h_q, res, cots):
     """Exact backward for BOTH outputs (o, lse) by recomputing the chunk with
     the differentiable XLA path. Ring attention's online-softmax merge takes
     real gradients through lse, which the FlashAttention-2 backward (defined
@@ -542,15 +544,23 @@ def _flash_lse_bwd(scale, causal, offset, block_q, block_k, interpret, res, cots
     q, k, v = res
     from photon_tpu.ops.ring_attention import xla_chunk_attention
 
+    bh_q = q.shape[0]
+    group = bh_q // k.shape[0]
+
     def chunk(q3, k3, v3):
-        # [bh, s, d] → [bh, s, 1, d] for the [b, s, h, d] chunk oracle;
+        # flat rows → the [b, s, h, d] chunk oracle: each kv row becomes a
+        # "batch" entry holding its GROUP of q heads (group == 1 for MHA);
         # pass the kernel's scale explicitly (inputs are lane-padded, so
         # 1/sqrt(padded_d) would be wrong)
+        s_q, d = q3.shape[1:]
+        q4 = q3.reshape(bh_q // group, group, s_q, d).transpose(0, 2, 1, 3)
         o4, lse3 = xla_chunk_attention(
-            q3[:, :, None, :], k3[:, :, None, :], v3[:, :, None, :],
+            q4, k3[:, :, None, :], v3[:, :, None, :],
             q_start=offset, k_start=0, causal=causal, scale=scale,
         )
-        return o4[:, :, 0, :], lse3[:, :, 0]
+        o3 = o4.transpose(0, 2, 1, 3).reshape(bh_q, s_q, d)
+        lse_o = lse3.transpose(0, 2, 1).reshape(bh_q, s_q)
+        return o3, lse_o
 
     _, vjp = jax.vjp(chunk, q, k, v)
     return vjp(cots)
@@ -573,8 +583,13 @@ def flash_attention_with_lse(
 ) -> tuple[jax.Array, jax.Array]:
     """Like :func:`flash_attention` but over global positions
     (``q_start``/``k_start`` are the chunks' sequence offsets) and returning
-    ``(o [b,s,h,d], lse [b,s,h])`` for online-softmax merging across chunks."""
+    ``(o [b,s,h,d], lse [b,s,h])`` for online-softmax merging across chunks.
+    Grouped-query attention: ``k``/``v`` may carry fewer heads than ``q``
+    (consumed natively, same as :func:`flash_attention`)."""
     b, s_q, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv or v.shape[2] != h_kv:
+        raise ValueError(f"bad GQA head split: q {h}, k {h_kv}, v {v.shape[2]}")
     s_k = k.shape[1]
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)
@@ -583,13 +598,14 @@ def flash_attention_with_lse(
     scale = 1.0 / (d**0.5)
     d_pad = max(LANE, ((d + LANE - 1) // LANE) * LANE)
 
-    def to_bh(x, s):
-        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+    def to_bh(x, s, heads):
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * heads, s, d)
         if d_pad != d:
             x = jnp.pad(x, ((0, 0), (0, 0), (0, d_pad - d)))
         return x
 
-    qb, kb, vb = to_bh(q, s_q), to_bh(k, s_k), to_bh(v, s_k)
-    ob, lse = _flash_lse(qb, kb, vb, scale, causal, q_start - k_start, block_q, block_k, interpret)
+    qb, kb, vb = to_bh(q, s_q, h), to_bh(k, s_k, h_kv), to_bh(v, s_k, h_kv)
+    ob, lse = _flash_lse(qb, kb, vb, scale, causal, q_start - k_start, block_q,
+                         block_k, interpret, h if h_kv != h else 0)
     o = jnp.transpose(ob[..., :d].reshape(b, h, s_q, d), (0, 2, 1, 3))
     return o, jnp.transpose(lse.reshape(b, h, s_q), (0, 2, 1))

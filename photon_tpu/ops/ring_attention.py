@@ -61,8 +61,12 @@ def xla_chunk_attention(q, k, v, *, q_start: int, k_start: int, causal: bool,
     """
     d = q.shape[-1]
     scale = (1.0 / (d**0.5)) if scale is None else scale
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
-    q_pos = jnp.arange(q.shape[1])[:, None] + q_start
+    b, sq, h, _ = q.shape
+    h_kv = k.shape[2]
+    g = h // h_kv  # grouped-query: q heads share kv group rows (g == 1: MHA)
+    qg = q.reshape(b, sq, h_kv, g, d)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32) * scale
+    q_pos = jnp.arange(sq)[:, None] + q_start
     k_pos = jnp.arange(k.shape[1])[None, :] + k_start
     if alibi is not None and alibi is not False:
         # ``alibi`` is the per-head slopes array for THESE heads ([h_local] —
@@ -70,20 +74,21 @@ def xla_chunk_attention(q, k, v, *, q_start: int, k_start: int, causal: bool,
         # the local head count) or True for all-heads contexts
         from photon_tpu.ops.attention import alibi_slopes
 
-        slopes = alibi_slopes(q.shape[2]) if alibi is True else jnp.asarray(alibi)
+        slopes = alibi_slopes(h) if alibi is True else jnp.asarray(alibi)
         dist = (q_pos - k_pos).astype(jnp.float32)
-        s = s - slopes[None, :, None, None] * dist[None, None]
+        s = s - slopes.reshape(h_kv, g)[None, :, :, None, None] * dist[None, None, None]
     if causal:
-        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+        s = jnp.where((q_pos >= k_pos)[None, None, None], s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     masked_all = m <= NEG_INF / 2
     p = jnp.where(masked_all, 0.0, jnp.exp(s - jnp.where(masked_all, 0.0, m)))
     l = jnp.sum(p, axis=-1, keepdims=True)
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    o = jnp.einsum("bhqk,bkhd->bqhd", (p / l_safe).astype(v.dtype), v)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", (p / l_safe).astype(v.dtype), v)
+    o = o.reshape(b, sq, h, d)
     lse = jnp.where(masked_all[..., 0], NEG_INF, m[..., 0] + jnp.log(l_safe[..., 0]))
-    # lse: [b, h, sq] → [b, sq, h]
-    return o, jnp.transpose(lse, (0, 2, 1))
+    # lse: [b, h_kv, g, sq] → [b, sq, h]
+    return o, jnp.transpose(lse, (0, 3, 1, 2)).reshape(b, sq, h)
 
 
 def _chunk_attn(q, k, v, *, q_start, k_start, causal, impl, alibi=None):
@@ -116,11 +121,19 @@ def ring_attention(
     spec names it, so TP composes — no gather at the shard_map boundary).
     ``alibi`` applies the distance bias with GLOBAL positions; slopes travel
     as a sharded input so each head shard uses its own slice.
+
+    Grouped-query attention: ``k``/``v`` may carry fewer heads than ``q`` —
+    the kv chunks then ROTATE THE RING at their grouped width, cutting the
+    per-step ``ppermute`` payload by the group factor (the dominant ring
+    cost); the inner chunk kernel consumes the groups natively.
     """
     from photon_tpu.ops.attention import alibi_slopes as _make_slopes
 
     n_ring = mesh.shape[axis_name]
     h = q.shape[2]
+    h_kv = k.shape[2]
+    if h % h_kv or v.shape[2] != h_kv:
+        raise ValueError(f"bad GQA head split: q {h}, k {h_kv}, v {v.shape[2]}")
     if n_ring == 1:
         return _chunk_attn(
             q, k, v, q_start=0, k_start=0, causal=causal, impl=impl,
@@ -130,7 +143,9 @@ def ring_attention(
     if s_global % n_ring:
         raise ValueError(f"seq {s_global} not divisible by ring size {n_ring}")
     s_local = s_global // n_ring
-    h_axis = head_axis if head_axis in mesh.shape and h % mesh.shape[head_axis] == 0 else None
+    h_axis = head_axis if head_axis in mesh.shape \
+        and h % mesh.shape[head_axis] == 0 \
+        and h_kv % mesh.shape[head_axis] == 0 else None
     spec = P(batch_axes, axis_name, h_axis, None)
     slopes_full = _make_slopes(h) if alibi else jnp.zeros((h,), jnp.float32)
     slopes_spec = P(h_axis)
@@ -148,10 +163,12 @@ def ring_attention(
             if causal and src > my_idx:
                 # statically dead: the whole k/v chunk is in this device's
                 # future — skip the kernel (≈half the ring FLOPs for causal).
-                # Outputs are built FROM the inputs (×0) so they carry the
+                # Outputs are built FROM the inputs (×0, via scalar sums so
+                # GQA head widths never have to broadcast) so they carry the
                 # same varying-axes (vma) as the kernel branch — lax.switch
                 # requires all branches to agree.
-                zero = q_l * 0 + k_c[:, :1] * 0 + v_c[:, :1] * 0 + slopes_l[None, None, :, None] * 0
+                zero = (q_l * 0 + (k_c.sum() + v_c.sum()).astype(q_l.dtype) * 0
+                        + slopes_l.sum().astype(q_l.dtype) * 0)
                 lse = zero.sum(axis=-1).astype(jnp.float32) + NEG_INF
                 return zero.astype(q_l.dtype), lse
             return _chunk_attn(

@@ -144,3 +144,21 @@ def test_sharded_flash_gradients_match_xla(qkv):
     for a, b in zip(g_flash, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-2, rtol=5e-2)
+
+
+def test_ring_dispatch_mqa_kv_indivisible_by_tensor(qkv):
+    # MQA (1 kv head) with tensor=2 and a sequence axis: kv heads can't
+    # split over tensor, so the dispatch must replicate kv BEFORE ring
+    # attention (ring's own spec would otherwise silently drop head
+    # sharding for q too)
+    q, _, _ = qkv
+    rng = np.random.default_rng(11)
+    k = jnp.asarray(rng.normal(size=(B, S, 1, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, 1, D)), jnp.float32)
+    rep = lambda x: jnp.repeat(x, H, axis=2)
+    ref = xla_attention(q, rep(k), rep(v), causal=True, alibi=False)
+    with use_mesh(_mesh(tensor=2, sequence=2)):
+        out = multihead_attention(q, k, v, impl="ring", causal=True,
+                                  alibi=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)

@@ -173,3 +173,48 @@ def test_gqa_native_parity(group, alibi):
     for a, ref in zip(gk, gx):
         assert a.shape == ref.shape
         assert _rel(a, ref) < 2e-4
+
+
+def test_lse_path_gqa_parity():
+    """The ring inner kernel with grouped kv (_flash_lse h_q plumbing):
+    (o, lse) forward AND gradients through BOTH outputs vs the
+    replicated-kv chunk oracle — covers the _flash_lse_bwd group-reshape
+    recompute, which no TPU is needed to regress."""
+    group = 2
+    q, _, _ = _qkv(s=128)
+    h_kv = H // group
+    ks = jax.random.split(jax.random.PRNGKey(21), 2)
+    k = jax.random.normal(ks[0], (B, 128, h_kv, D))
+    v = jax.random.normal(ks[1], (B, 128, h_kv, D))
+
+    def rep(x):
+        return jnp.repeat(x, group, axis=2)
+
+    o_k, lse_k = flash_attention_with_lse(
+        q, k, v, causal=True, q_start=128, k_start=0,
+        block_q=BLOCK, block_k=BLOCK, interpret=True,
+    )
+    o_x, lse_x = xla_chunk_attention(q, rep(k), rep(v), q_start=128, k_start=0,
+                                     causal=True)
+    assert _rel(o_k, o_x) < 2e-5
+    assert _rel(lse_k, lse_x) < 2e-5
+
+    wo = jax.random.normal(jax.random.PRNGKey(22), o_x.shape)
+    wl = jax.random.normal(jax.random.PRNGKey(23), lse_x.shape)
+
+    def loss_kernel(q, k, v):
+        o, lse = flash_attention_with_lse(
+            q, k, v, causal=True, q_start=128, k_start=0,
+            block_q=BLOCK, block_k=BLOCK, interpret=True)
+        return (o * wo).sum() + (lse * wl).sum()
+
+    def loss_ref(q, k, v):
+        o, lse = xla_chunk_attention(q, rep(k), rep(v), q_start=128, k_start=0,
+                                     causal=True)
+        return (o * wo).sum() + (lse * wl).sum()
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, ref in zip(gk, gx):
+        assert a.shape == ref.shape
+        assert _rel(a, ref) < 2e-4
