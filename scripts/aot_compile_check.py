@@ -54,6 +54,19 @@ def log(msg: str) -> None:
     print(f"[aot] {msg}", file=sys.stderr, flush=True)
 
 
+def hbm_gib(compiled) -> float | None:
+    """args + outputs + temps in GiB (naive sum: donated aliases are
+    double-counted, so the true peak is lower; the compiler's own budget
+    check is the pass/fail signal)."""
+    try:
+        ma = compiled.memory_analysis()
+        return round((ma.argument_size_in_bytes + ma.output_size_in_bytes
+                      + ma.temp_size_in_bytes) / 2**30, 2)
+    except Exception as e:  # noqa: BLE001 — analysis is best-effort
+        log(f"memory_analysis unavailable: {e}")
+        return None
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--micro", type=int, default=2)
@@ -69,19 +82,23 @@ def main() -> int:
                     "'data=2,fsdp=2' (unnamed axes default to 1)")
     ap.add_argument("--topo", default="v5e:2x2x1",
                     help="TPU topology to compile against")
-    ap.add_argument("--program", default="train", choices=["train", "eval", "decode"],
+    ap.add_argument("--program", default="train",
+                    choices=["train", "eval", "decode", "collective"],
                     help="train = the jitted train step; eval = the chunked "
                     "eval step (convergence-stage val pass); decode = the "
                     "KV-cache prefill + per-token decode_step pair the "
-                    "gauntlet's generation scorer compiles on-chip")
+                    "gauntlet's generation scorer compiles on-chip; "
+                    "collective = the federated weighted-psum aggregation "
+                    "over a clients axis spanning the whole topology")
     ap.add_argument("--batch", type=int, default=8, help="decode batch rows")
     args = ap.parse_args()
     if ":" not in args.topo:
         ap.error(f"--topo must look like 'v5e:2x2x1', got {args.topo!r}")
-    if args.program == "decode" and args.mesh:
-        # the gauntlet's inference pair runs single-chip; compiling it
-        # sharded would report numbers for a program the stage never builds
-        ap.error("--program decode is single-device; drop --mesh")
+    if args.program in ("decode", "collective") and args.mesh:
+        # decode runs single-chip; collective builds its OWN 1-D clients
+        # mesh over every topology device — a tp/fsdp mesh would compile a
+        # program neither stage ever builds
+        ap.error(f"--program {args.program} ignores --mesh; drop it")
 
     from jax.experimental import topologies
     from jax.sharding import NamedSharding
@@ -129,6 +146,13 @@ def main() -> int:
     dev = topo.devices[0]
     log(f"abstract device: {dev.device_kind} x{len(topo.devices)}")
 
+    # decode/collective build their own device layout (single chip / 1-D
+    # clients mesh) — dispatch before the training-mesh construction
+    if args.program == "decode":
+        return _compile_decode(args, cfg, topo, dev)
+    if args.program == "collective":
+        return _compile_collective(args, cfg, topo, dev)
+
     from photon_tpu.config.schema import MeshConfig
     from photon_tpu.parallel.context import use_mesh
     from photon_tpu.parallel.mesh import make_mesh
@@ -145,9 +169,6 @@ def main() -> int:
     cfg.mesh = mesh_cfg
     cfg.validate()  # re-validate with the mesh (e.g. pallas→ring upgrade)
     mesh = make_mesh(mesh_cfg, devices=list(topo.devices))
-
-    if args.program == "decode":
-        return _compile_decode(args, cfg, topo, dev)
 
     model = MPTModel(cfg.model)
     tx, _ = build_optimizer(cfg.optimizer, cfg.scheduler)
@@ -215,15 +236,12 @@ def main() -> int:
         "compile_s": round(t2 - t1, 1),
         "device_kind": dev.device_kind,
     }
+    out["hbm_gib"] = hbm_gib(compiled)
     try:
-        ma = compiled.memory_analysis()
-        out["hbm_gib"] = round(
-            (ma.argument_size_in_bytes + ma.output_size_in_bytes
-             + ma.temp_size_in_bytes) / 2**30, 2)
-        out["temp_gib"] = round(ma.temp_size_in_bytes / 2**30, 2)
-    except Exception as e:  # noqa: BLE001 — analysis is best-effort
-        out["hbm_gib"] = None
-        log(f"memory_analysis unavailable: {e}")
+        out["temp_gib"] = round(
+            compiled.memory_analysis().temp_size_in_bytes / 2**30, 2)
+    except Exception:  # noqa: BLE001 — analysis is best-effort
+        out["temp_gib"] = None
     print(json.dumps(out), flush=True)
     return 0
 
@@ -275,14 +293,6 @@ def _compile_decode(args, cfg, topo, dev) -> int:
         step_c = step.lower(params, state, token).compile()
     t2 = time.perf_counter()
 
-    def _mem(compiled):
-        try:
-            ma = compiled.memory_analysis()
-            return round((ma.argument_size_in_bytes + ma.output_size_in_bytes
-                          + ma.temp_size_in_bytes) / 2**30, 2)
-        except Exception:  # noqa: BLE001
-            return None
-
     print(json.dumps({
         "ok": True,
         "program": "decode",
@@ -294,8 +304,54 @@ def _compile_decode(args, cfg, topo, dev) -> int:
         "impl": mcfg.attn_impl,
         "prefill_compile_s": round(t1 - t0, 1),
         "decode_step_compile_s": round(t2 - t1, 1),
-        "prefill_hbm_gib": _mem(pre_c),
-        "decode_step_hbm_gib": _mem(step_c),
+        "prefill_hbm_gib": hbm_gib(pre_c),
+        "decode_step_hbm_gib": hbm_gib(step_c),
+        "device_kind": dev.device_kind,
+    }), flush=True)
+    return 0
+
+
+def _compile_collective(args, cfg, topo, dev) -> int:
+    """Compile the federated weighted-psum aggregation — the TPU-native
+    replacement for the reference's S3 upload/download plane
+    (``parallel/collective_agg.py``) — with one client per topology device
+    and the FULL preset param pytree as the round payload."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from photon_tpu.models import init_params
+    from photon_tpu.parallel.collective_agg import (
+        CLIENT_AXIS,
+        collective_weighted_average,
+        make_client_mesh,
+    )
+    from photon_tpu.utils.heartbeat import heartbeat
+
+    n = len(topo.devices)
+    mesh = make_client_mesh(n, devices=list(topo.devices))
+    params = jax.eval_shape(lambda: init_params(cfg.model, seed=0))
+    row = NamedSharding(mesh, PartitionSpec(CLIENT_AXIS))
+    stacked = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct((n,) + s.shape, s.dtype, sharding=row),
+        params)
+    counts = jax.ShapeDtypeStruct((n,), jnp.int32, sharding=row)
+
+    t0 = time.perf_counter()
+    with heartbeat("[aot] still compiling"):
+        compiled = jax.jit(
+            lambda sp, c: collective_weighted_average(sp, c, mesh,
+                                                      return_total=True)
+        ).lower(stacked, counts).compile()
+    dt = time.perf_counter() - t0
+
+    print(json.dumps({
+        "ok": True,
+        "program": "collective",
+        "preset": args.preset or "125m-default",
+        "topo": args.topo,
+        "n_clients": n,
+        "compile_s": round(dt, 1),
+        "hbm_gib": hbm_gib(compiled),
         "device_kind": dev.device_kind,
     }), flush=True)
     return 0
