@@ -8,6 +8,11 @@ Or one-shot ladder tuning that writes the winner into bench_tuned.json
 (what the driver's bench pins on its first TPU attempt):
 
     python scripts/tpu_probe.py --auto [gbs]    # default gbs 256
+
+Env knobs: PHOTON_PROBE_NO_CHUNK=1 disables chunked CE (diagnostic);
+PALLAS_AXON_REMOTE_COMPILE=0 (set BEFORE launching python) compiles
+locally with the in-image libtpu instead of the remote compile service —
+see PERF.md round-5 postmortem for when that matters.
 """
 
 from __future__ import annotations
