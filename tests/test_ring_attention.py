@@ -140,3 +140,25 @@ def test_ring_gqa_grads_match_full():
         assert gr.shape == gf.shape
         np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_ring_gqa_with_tensor_sharded_heads():
+    """GQA widths through ring's head-sharding path: the tensor axis size
+    divides both h and h_kv, so the spec keeps heads sharded AND kv rides
+    the ring at grouped width per shard."""
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, tensor=2, sequence=2))
+    q, _, _ = _qkv(10)  # [B, S, H=2, D]
+    rng = np.random.default_rng(11)
+    k = jnp.asarray(rng.normal(size=(B, S, 2, D)), jnp.float32)  # h_kv == H
+    # make it GQA by DOUBLING q heads: H_q=4, h_kv=2, group 2 — both divide
+    # tensor=2
+    q4 = jnp.concatenate([q, q * 0.5], axis=2)
+    v = jnp.asarray(rng.normal(size=(B, S, 2, D)), jnp.float32)
+    rep = lambda x: jnp.repeat(x, 2, axis=2)  # noqa: E731
+
+    o_ring = jax.jit(
+        lambda a, b, c: ring_attention(a, b, c, mesh, causal=True, impl="xla")
+    )(q4, k, v)
+    o_full = xla_attention(q4, rep(k), rep(v), causal=True)
+    np.testing.assert_allclose(np.asarray(o_ring), np.asarray(o_full),
+                               rtol=1e-5, atol=1e-5)
