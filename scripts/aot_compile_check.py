@@ -28,20 +28,11 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import pathlib
 import sys
 import time
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
-
-# libtpu topology init wants the env a real TPU VM would have; mirror the
-# axon local-compile path (TPU_SKIP_MDS_QUERY avoids the GCP metadata-server
-# query that hangs off-VM)
-os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
-os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-4")
-os.environ.setdefault("TPU_TOPOLOGY", "2x2")
-os.environ["TPU_WORKER_HOSTNAMES"] = "localhost"
 
 import jax
 
@@ -100,7 +91,6 @@ def main() -> int:
         # program neither stage ever builds
         ap.error(f"--program {args.program} ignores --mesh; drop it")
 
-    from jax.experimental import topologies
     from jax.sharding import NamedSharding
 
     from photon_tpu.config import load_preset
@@ -133,16 +123,14 @@ def main() -> int:
     cfg.train.loss_chunk_tokens = args.chunk
     cfg.validate()
 
-    # topology shape drives libtpu's TPU_TOPOLOGY check; accelerator type
-    # stays v5litepod-4 (sets the 2x2 host bounds every shape must divide).
-    # v5e is a 2D generation: a trailing literal x1 dimension is sugar
-    # ("2x4x1" == "2x4") — strip exactly that, never a substring
-    shape = args.topo.split(":", 1)[1]
-    parts = shape.split("x")
-    if args.topo.startswith("v5e:") and len(parts) == 3 and parts[2] == "1":
-        shape = "x".join(parts[:2])
-    os.environ["TPU_TOPOLOGY"] = shape
-    topo = topologies.get_topology_desc(platform="tpu", topology_name=args.topo)
+    # env incantation + topology construction shared with the tests
+    # (photon_tpu.parallel.topo)
+    from photon_tpu.parallel.topo import abstract_tpu_devices
+
+    class _Topo:  # adapter: downstream code reads .devices
+        devices = abstract_tpu_devices(args.topo)
+
+    topo = _Topo()
     dev = topo.devices[0]
     log(f"abstract device: {dev.device_kind} x{len(topo.devices)}")
 
