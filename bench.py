@@ -37,6 +37,16 @@ watches child stderr with an inactivity watchdog
 killed fast, salvaging any already-emitted result, instead of burning the
 whole hard-timeout window (round-4 postmortem: a wedged relay froze the
 child inside parity compile #5 with zero output for 25 minutes).
+
+Stage orchestration (round-5 live-relay observation): both live sessions
+wedged ~12-14 min into a single long relay claim — always at the next RPC
+past that horizon — while fresh claims kept working. The supervisor
+therefore runs parity and each evidence stage in its OWN child process
+(`--stage parity|conv|gauntlet|1b`), each a fresh short claim with its own
+watchdog; the conv stage persists its trained params
+(.conv_slice_params.msgpack) so the gauntlet stage can score them from a
+different process. A stage that stalls is killed and the next stage still
+gets a fresh claim; stage outcomes land under "stages" in the JSON line.
 MFU is reported against the detected chip's bf16 peak (utils/profiling.py).
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
@@ -54,7 +64,7 @@ PHOTON_BENCH_SKIP_PARITY=1 (skip the kernel parity check),
 PHOTON_BENCH_SECOND_MICRO (pinned-config second microbatch trial after the
 first emit; default 2x the pinned micro, 0 disables),
 PHOTON_BENCH_TRY_BLOCK (flash tile trial after the micro trials; default
-512, 0 disables),
+512, or 0 — disabled — when PHOTON_BENCH_FLASH_BLOCK pins a measured tile),
 PHOTON_BENCH_SKIP_SWEEP=1 (skip the microbatch sweep),
 PHOTON_BENCH_PROFILE=1 (write a jax.profiler trace of the timed window),
 PHOTON_BENCH_ATTN (force attn_impl: xla|pallas — the safe rung uses xla),
@@ -104,8 +114,8 @@ def emit(obj: dict) -> None:
 # ---------------------------------------------------------------------------
 
 
-def _scan_result(stdout: str) -> dict | None:
-    """Last JSON line carrying the headline metric, if any."""
+def _scan_json(stdout: str, pred) -> dict | None:
+    """Last JSON line in ``stdout`` satisfying ``pred``, if any."""
     for line in reversed(stdout.splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -113,9 +123,19 @@ def _scan_result(stdout: str) -> dict | None:
                 cand = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            if cand.get("metric") == METRIC:
+            if pred(cand):
                 return cand
     return None
+
+
+def _scan_result(stdout: str) -> dict | None:
+    """Last JSON line carrying the headline metric, if any."""
+    return _scan_json(stdout, lambda c: c.get("metric") == METRIC)
+
+
+def _scan_stage(stdout: str, stage: str) -> dict | None:
+    """Last {"stage": <stage>, ...} JSON line from a stage child, if any."""
+    return _scan_json(stdout, lambda c: c.get("stage") == stage)
 
 
 # The full-recipe rung pins the configuration proven on hardware
@@ -264,17 +284,6 @@ class _Child:
         return "\n".join(self.stderr_lines)
 
 
-def _stamp_parity_death(result: dict, platform: str, why: str) -> None:
-    """A TPU child that died/stalled AFTER the headline emit but BEFORE the
-    parity re-emit must not look like parity was merely skipped — stamp an
-    explicit failure so the JSON distinguishes 'not run' from 'died mid-run'."""
-    if os.environ.get("PHOTON_BENCH_SKIP_PARITY") == "1":
-        return  # parity legitimately not attempted
-    if platform == "tpu" and "kernel_parity_ok" not in result:
-        result["kernel_parity_ok"] = False
-        result["kernel_parity_error"] = why
-
-
 def supervise() -> int:
     """Bank-then-upgrade ladder (round-5 live-relay postmortem).
 
@@ -314,6 +323,12 @@ def supervise() -> int:
     def run_rung(label: str, platform: str, tmo: int, extra_env: dict,
                  c_idle: int | None = None):
         env = dict(os.environ, **extra_env)
+        # throughput rungs never run parity/stages inline: both live-relay
+        # sessions this round wedged ~12-14 min into one long claim, always
+        # at the next RPC past that horizon, while fresh claims kept
+        # working. The supervisor runs each stage in its own child (= its
+        # own short relay claim) after the headline is banked.
+        env["PHOTON_BENCH_ORCHESTRATED"] = "1"
         env["PHOTON_BENCH_CHILD_DEADLINE"] = str(time.time() + tmo - 90)
         cmd = [sys.executable, str(pathlib.Path(__file__).resolve()),
                "--run", "--platform", platform]
@@ -347,13 +362,93 @@ def supervise() -> int:
         emit(result)
         return 0
 
+    def run_stage_children(result: dict) -> None:
+        """Parity + evidence stages, each in its OWN child process with a
+        FRESH relay claim (see run_rung comment: long claims wedge at the
+        ~12-min horizon; short ones route around it). Every stage writes
+        its own atomic artifact, so a killed stage loses only itself and
+        the next stage still gets a fresh claim. Stage outcomes land in
+        result["stages"]; the parity stage's verdict becomes
+        result["kernel_parity_ok"]."""
+        if result.get("platform") != "tpu":
+            return
+        e = os.environ
+        stages: list[tuple[str, int]] = []
+        if e.get("PHOTON_BENCH_SKIP_PARITY") != "1":
+            stages.append(("parity", 760))
+        if e.get("PHOTON_BENCH_SKIP_STAGES") != "1":
+            if e.get("PHOTON_BENCH_CONV", "1") != "0":
+                stages.append(("conv", 760))
+                if e.get("PHOTON_BENCH_GAUNTLET", "1") != "0":
+                    stages.append(("gauntlet", 700))
+            if e.get("PHOTON_BENCH_1B", "1") != "0":
+                stages.append(("1b", 600))
+        if not stages:
+            return
+        if any(s == "gauntlet" for s, _ in stages):
+            SLICE_PARAMS_PATH.unlink(missing_ok=True)  # no stale params
+        stage_recs = result.setdefault("stages", {})
+        skip: set[str] = set()
+        for stage, tmo in stages:
+            if stage in skip:
+                stage_recs[stage] = {
+                    "ok": False, "outcome": "skipped: conv saved no params"}
+                continue
+            env = dict(os.environ)
+            env["PHOTON_BENCH_CHILD_DEADLINE"] = str(time.time() + tmo - 60)
+            # run every stage at the winning rung's configuration
+            if result.get("flash_block"):
+                env.setdefault("PHOTON_BENCH_FLASH_BLOCK",
+                               str(result["flash_block"]))
+            if result.get("microbatch"):
+                env.setdefault("PHOTON_BENCH_MICROBATCH",
+                               str(result["microbatch"]))
+            cmd = [sys.executable, str(pathlib.Path(__file__).resolve()),
+                   "--stage", stage, "--platform", "tpu"]
+            log(f"stage {stage}: spawning (hard {tmo}s)")
+            t0 = time.monotonic()
+            child = _Child(cmd, env, hard_timeout=tmo,
+                           idle_timeout=idle_timeout,
+                           compile_idle_timeout=min(600, tmo))
+            rc, timed_out = child.wait()
+            rec = {"rc": rc, "stalled": bool(timed_out),
+                   "seconds": round(time.monotonic() - t0, 1),
+                   "device_ok": child._device_ok}
+            info = _scan_stage(child.stdout, stage)
+            if info is not None:
+                rec.update({k: v for k, v in info.items() if k != "stage"})
+            else:
+                rec["ok"] = False
+                rec["outcome"] = _classify(child.stderr, timed_out)
+            stage_recs[stage] = rec
+            if stage == "parity":
+                result["kernel_parity_ok"] = bool(rec.get("ok", False))
+                if not rec.get("ok"):
+                    # a delivered ok=false verdict is a NUMERICAL failure
+                    # (outcome/error only exist when the stage itself died)
+                    result["kernel_parity_error"] = str(
+                        rec.get("error") or rec.get("outcome")
+                        or "kernel parity failed (rel err beyond tolerance)"
+                    )[:300]
+            log(f"stage {stage}: {'ok' if rec.get('ok') else 'FAILED'} "
+                f"in {rec['seconds']}s")
+            if timed_out and not child._device_ok:
+                # the claim itself hung: the relay is wedged/dead — each
+                # further stage would burn a full watchdog window for nothing
+                log("stage never reached the device; skipping remaining stages")
+                result["stages_skipped"] = "relay gone mid-ladder"
+                break
+            if stage == "conv" and not rec.get("params_saved"):
+                # the gauntlet stage can only score saved conv params —
+                # don't burn a fresh claim on a known-empty run
+                skip.add("gauntlet")
+                log("conv stage saved no params; gauntlet stage dropped")
+
     forced = os.environ.get("PHOTON_BENCH_PLATFORM", "")
     if forced:
         result, rec = run_rung(f"forced-{forced}", forced, 1800, {})
         if result is not None:
-            if rec["stalled"] or rec["rc"] not in (0, None):
-                _stamp_parity_death(result, forced,
-                                    f"child died/stalled ({rec['outcome']})")
+            run_stage_children(result)
             return finish(result)
         emit({"metric": METRIC, "value": 0.0, "unit": "tokens/sec",
               "vs_baseline": 0.0,
@@ -429,32 +524,16 @@ def supervise() -> int:
                 full, full_rec = run_rung("tpu-full-auto", "tpu", 1200,
                                           dict(mode))
         if full is not None:
-            if full_rec["stalled"] or full_rec["rc"] != 0:
-                # a crash/stall AFTER the headline emit but inside the
-                # parity suite must not read as "parity merely skipped"
-                _stamp_parity_death(full, "tpu",
-                                    f"child died/stalled mid-run "
-                                    f"({full_rec['outcome']})")
             if banked is None or full.get("value", 0.0) >= banked.get("value", 0.0):
                 banked = full
             else:
                 log(f"full rung slower ({full.get('value')} vs "
                     f"{banked.get('value')} tok/s) — keeping the safe rung result")
-                # the slower full result still carries the parity verdict —
-                # the safe rung ran with PHOTON_BENCH_SKIP_PARITY=1
-                for key in ("kernel_parity_ok", "kernel_parity_error"):
-                    if key in full:
-                        banked[key] = full[key]
-        if banked is not None and "kernel_parity_ok" not in banked \
-                and os.environ.get("PHOTON_BENCH_SKIP_PARITY") != "1":
-            # the safe rung skipped parity and no full rung delivered it:
-            # stamp the absence explicitly (_stamp_parity_death invariant —
-            # "not run" must be distinguishable from "looks skipped")
-            banked["kernel_parity_ok"] = False
-            banked["kernel_parity_error"] = (
-                "parity not run: safe rung skips it and no full rung "
-                "produced a result")
     if banked is not None:
+        # parity + evidence stages run AFTER the headline is banked, each
+        # as its own short-claim child; the parity stage (not the rungs)
+        # is the source of kernel_parity_ok
+        run_stage_children(banked)
         return finish(banked)
 
     result, rec = run_rung("cpu-fallback", "cpu", 900, {})
@@ -627,6 +706,20 @@ def _atomic_json(path: pathlib.Path, obj: dict) -> None:
     os.replace(tmp, path)
 
 
+# the convergence stage's trained params, handed to the gauntlet stage
+# ACROSS PROCESSES (each stage runs in its own child = its own short relay
+# claim); ~250 MB of bf16 leaves, gitignored
+SLICE_PARAMS_PATH = HERE / ".conv_slice_params.msgpack"
+
+
+def _load_slice_params():
+    if not SLICE_PARAMS_PATH.exists():
+        return None
+    from flax import serialization
+
+    return serialization.msgpack_restore(SLICE_PARAMS_PATH.read_bytes())
+
+
 def _corpus_tokens():
     """Real-English byte tokens (site-packages docstrings — the zero-egress
     corpus recipe from scripts/make_local_corpus.py), cached as uint8."""
@@ -756,6 +849,23 @@ def tpu_convergence_slice(dev) -> dict | None:
             import jax
 
             host_params = jax.device_get(trainer.state.params)
+        if host_params is not None \
+                and os.environ.get("PHOTON_BENCH_SAVE_SLICE_PARAMS") == "1":
+            # persist for a gauntlet stage running in its own process (set
+            # by run_stage("conv"); the inline --run path hands params over
+            # in-memory and skips the ~250 MB serialize)
+            try:
+                from flax import serialization
+
+                # atomic (tmp + rename): a watchdog kill mid-write must not
+                # leave a truncated msgpack that passes the exists() check
+                tmp = SLICE_PARAMS_PATH.with_suffix(".tmp")
+                tmp.write_bytes(serialization.msgpack_serialize(host_params))
+                os.replace(tmp, SLICE_PARAMS_PATH)
+                log(f"slice params saved "
+                    f"({SLICE_PARAMS_PATH.stat().st_size / 2**20:.0f} MB)")
+            except Exception as e:  # noqa: BLE001 — in-process handoff still works
+                log(f"slice param save failed: {type(e).__name__}: {e}")
         res["complete"] = True
         _atomic_json(out_path, res)
         trainer.state = None  # free HBM for the next stage
@@ -1228,7 +1338,11 @@ def run(platform: str) -> None:
 
     # Flash tile trial (PERF.md lever 2): 512x512 blocks halve the number of
     # grid steps at seq 2048; worth one compile once a result is safe.
-    block = int(os.environ.get("PHOTON_BENCH_TRY_BLOCK", "512"))
+    # when the tuned config already pins a measured-winner tile, default the
+    # trial OFF (the 256→512→1024 ladder was measured on-chip round 5;
+    # 2048 is compile-rejected: scoped-vmem 23M > 16M)
+    block = int(os.environ.get("PHOTON_BENCH_TRY_BLOCK",
+                               "0" if tuned_block else "512"))
     if on_tpu and block and cfg.model.attn_impl == "pallas" \
             and block != cfg.model.flash_block_q:
         def _blocks(c, b=block):
@@ -1257,7 +1371,12 @@ def run(platform: str) -> None:
                 t3.state = None
                 del t3
 
-    if on_tpu and os.environ.get("PHOTON_BENCH_SKIP_PARITY") != "1":
+    # under the supervisor (PHOTON_BENCH_ORCHESTRATED) parity and the
+    # evidence stages run in their own child processes with fresh relay
+    # claims; inline execution remains for manual `--run` invocations
+    orchestrated = os.environ.get("PHOTON_BENCH_ORCHESTRATED") == "1"
+    if on_tpu and not orchestrated \
+            and os.environ.get("PHOTON_BENCH_SKIP_PARITY") != "1":
         # free the trainer's HBM first — parity allocates its own test tensors
         trainer.state = None
         t0 = time.perf_counter()
@@ -1272,7 +1391,8 @@ def run(platform: str) -> None:
             out["kernel_parity_ok"] = parity["ok"]
         emit(out)
 
-    if on_tpu and os.environ.get("PHOTON_BENCH_SKIP_STAGES") != "1":
+    if on_tpu and not orchestrated \
+            and os.environ.get("PHOTON_BENCH_SKIP_STAGES") != "1":
         # evidence stages: everything above already emitted + re-emitted, so
         # these can only ADD artifacts (CONVERGENCE_TPU.json,
         # GAUNTLET_TPU.json, PERF_1B_MEASURED.json), never cost the round
@@ -1284,17 +1404,99 @@ def run(platform: str) -> None:
         one_b_memory_probe(dev)
 
 
+def run_stage(stage: str, platform: str) -> int:
+    """One parity/evidence stage in its own process — its own SHORT relay
+    claim (long claims wedge at the ~12-min horizon; see supervise()).
+    Emits a final {"stage", "ok", ...} JSON line for the supervisor."""
+    from photon_tpu.utils.relay import relay_listening
+
+    if platform == "tpu" and os.environ.get("PALLAS_AXON_POOL_IPS") \
+            and not relay_listening():
+        raise RuntimeError("dead-relay: no axon relay listener on 127.0.0.1")
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    cache_dir = HERE / ".jax_cache"
+    cache_dir.mkdir(exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    t0 = time.perf_counter()
+    dev = jax.devices()[0]
+    log(f"backend up in {time.perf_counter() - t0:.1f}s: {dev} "
+        f"kind={dev.device_kind}")
+
+    t_stage = time.time()
+
+    def artifact(name: str) -> dict:
+        """The stage's artifact — but only if it was (re)written by THIS
+        run: prior-session artifacts can be on disk (some are committed),
+        and a stage that early-returned without writing must not report
+        ok from a stale file."""
+        path = HERE / name
+        try:
+            if path.stat().st_mtime < t_stage - 1.0:
+                return {}
+            return json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            return {}
+
+    if stage == "parity":
+        try:
+            parity = kernel_parity(full=True, sink=_parity_sink)
+            emit({"stage": "parity", "ok": bool(parity["ok"])})
+        except Exception as e:  # noqa: BLE001 — verdict must reach the supervisor
+            emit({"stage": "parity", "ok": False,
+                  "error": f"{type(e).__name__}: {e}"[:300]})
+    elif stage == "conv":
+        # cross-process mode: the gauntlet stage runs in ANOTHER process,
+        # so the trained params must be persisted (inline --run hands them
+        # over in-memory and skips the ~250 MB serialize)
+        os.environ["PHOTON_BENCH_SAVE_SLICE_PARAMS"] = "1"
+        tpu_convergence_slice(dev)
+        emit({"stage": "conv",
+              "ok": bool(artifact("CONVERGENCE_TPU.json").get("complete")),
+              "params_saved": SLICE_PARAMS_PATH.exists()})
+    elif stage == "gauntlet":
+        params = _load_slice_params()
+        if params is None:
+            emit({"stage": "gauntlet", "ok": False,
+                  "error": "no saved slice params (conv stage incomplete?)"})
+        else:
+            gauntlet_on_slice(params, dev)
+            art = artifact("GAUNTLET_TPU.json")
+            # deadline partials count as ok (scores are real); a crash that
+            # left partial scores does not — the error key tells them apart
+            out = {"stage": "gauntlet",
+                   "ok": bool((art.get("complete") or art.get("scores"))
+                              and not art.get("error"))}
+            if art.get("error"):
+                out["error"] = art["error"]
+            emit(out)
+    elif stage == "1b":
+        one_b_memory_probe(dev)
+        emit({"stage": "1b",
+              "ok": bool(artifact("PERF_1B_MEASURED.json").get("complete"))})
+    else:
+        raise ValueError(f"unknown stage {stage!r}")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--run", action="store_true", help="run the bench in-process (child mode)")
     ap.add_argument("--platform", default="tpu", choices=["tpu", "cpu"])
     ap.add_argument("--kernel-parity", action="store_true",
                     help="run only the Pallas-vs-XLA parity check and print its JSON")
+    ap.add_argument("--stage", choices=["parity", "conv", "gauntlet", "1b"],
+                    help="run ONE parity/evidence stage in-process (own relay claim)")
     args = ap.parse_args()
     if args.kernel_parity:
         parity = kernel_parity(full=True, sink=_parity_sink)
         emit(parity)
         return 0 if parity["ok"] else 1
+    if args.stage:
+        return run_stage(args.stage, args.platform)
     if args.run:
         run(args.platform)
         return 0

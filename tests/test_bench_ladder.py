@@ -16,12 +16,24 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
 @pytest.fixture()
-def bench(monkeypatch):
+def bench(monkeypatch, tmp_path):
     spec = importlib.util.spec_from_file_location("bench_under_test", REPO / "bench.py")
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    monkeypatch.delenv("PHOTON_BENCH_PLATFORM", raising=False)
-    monkeypatch.delenv("PHOTON_BENCH_MICROBATCH", raising=False)
+    # run_stage_children unlinks the conv-params handoff file before
+    # scheduling a gauntlet stage — point it at tmp so in-process ladder
+    # runs can't delete a real saved artifact in the repo root
+    monkeypatch.setattr(mod, "SLICE_PARAMS_PATH",
+                        tmp_path / ".conv_slice_params.msgpack")
+    # supervise() reads all of these from os.environ to schedule rungs and
+    # stage children — ambient values from a shell that previously drove
+    # the bench must not leak into the scripted ladder
+    for var in ("PHOTON_BENCH_PLATFORM", "PHOTON_BENCH_MICROBATCH",
+                "PHOTON_BENCH_FLASH_BLOCK", "PHOTON_BENCH_SKIP_PARITY",
+                "PHOTON_BENCH_SKIP_STAGES", "PHOTON_BENCH_CONV",
+                "PHOTON_BENCH_GAUNTLET", "PHOTON_BENCH_1B",
+                "PHOTON_BENCH_SAVE_SLICE_PARAMS"):
+        monkeypatch.delenv(var, raising=False)
     return mod
 
 
@@ -41,7 +53,7 @@ class FakeChild:
     def __init__(self, cmd, env, hard_timeout, idle_timeout,
                  compile_idle_timeout=None):
         spec = dict(self.script[len(self.built)])
-        self.built.append({"env": env, "spec": spec})
+        self.built.append({"cmd": cmd, "env": env, "spec": spec})
         self._spec = spec
         self.stdout = spec.get("stdout", "")
         self.stderr = spec.get("stderr", "")
@@ -49,6 +61,21 @@ class FakeChild:
 
     def wait(self):
         return self._spec.get("rc", 0), self._spec.get("stalled", False)
+
+
+def _stage_line(stage, ok=True, **extra):
+    return json.dumps({"stage": stage, "ok": ok, **extra})
+
+
+def _stage_children(parity_ok=True):
+    """Scripted outcomes for the four post-bank stage children (parity,
+    conv, gauntlet, 1b), each its own fresh-claim child process."""
+    return [
+        {"stdout": _stage_line("parity", ok=parity_ok), "stderr": "backend up"},
+        {"stdout": _stage_line("conv", params_saved=True), "stderr": "backend up"},
+        {"stdout": _stage_line("gauntlet"), "stderr": "backend up"},
+        {"stdout": _stage_line("1b"), "stderr": "backend up"},
+    ]
 
 
 @pytest.fixture()
@@ -68,8 +95,12 @@ def scripted(bench, monkeypatch, capsys):
 
 def test_full_rung_upgrades_safe_result(bench, scripted):
     final, built = scripted([
-        {"stdout": _result_line(bench, 30000.0), "stderr": "backend up\ncompile+step in 30s"},
-        {"stdout": _result_line(bench, 65000.0), "stderr": "backend up\ncompile+step in 31s"},
+        {"stdout": _result_line(bench, 30000.0, platform="tpu"),
+         "stderr": "backend up\ncompile+step in 30s"},
+        {"stdout": _result_line(bench, 65000.0, platform="tpu",
+                                flash_block=1024, microbatch=2),
+         "stderr": "backend up\ncompile+step in 31s"},
+        *_stage_children(),
     ])
     assert final["value"] == 65000.0
     assert [a["rung"] for a in final["attempts"]] == ["tpu-safe", "tpu-full-local"]
@@ -78,21 +109,36 @@ def test_full_rung_upgrades_safe_result(bench, scripted):
     # the safe rung must keep Mosaic out and pin the proven config
     assert built[0]["env"]["PHOTON_BENCH_ATTN"] == "xla"
     assert built[0]["env"]["PHOTON_BENCH_MICROBATCH"] == "2"
+    # throughput rungs never run parity/stages inline — the supervisor
+    # orchestrates them as fresh-claim children AT the winning config
+    assert built[1]["env"]["PHOTON_BENCH_ORCHESTRATED"] == "1"
+    stage_cmds = [b["cmd"] for b in built[2:]]
+    assert [c[c.index("--stage") + 1] for c in stage_cmds] == [
+        "parity", "conv", "gauntlet", "1b"]
+    assert built[2]["env"]["PHOTON_BENCH_FLASH_BLOCK"] == "1024"
+    assert built[2]["env"]["PHOTON_BENCH_MICROBATCH"] == "2"
+    assert final["kernel_parity_ok"] is True
+    assert final["stages"]["conv"]["ok"] is True
+    assert final["stages"]["gauntlet"]["ok"] is True
 
 
 def test_stalled_full_rung_keeps_banked_safe_result(bench, scripted):
+    # full rung stalls (claim may be wedged): the remote rung is not
+    # attempted; stage children still start, but the first one hanging
+    # with no device contact skips the rest (one watchdog window, not four)
     final, _ = scripted([
-        {"stdout": _result_line(bench, 30000.0), "stderr": "backend up\ncompile+step in 30s"},
+        {"stdout": _result_line(bench, 30000.0, platform="tpu"),
+         "stderr": "backend up\ncompile+step in 30s"},
         {"stdout": "", "stderr": "backend up", "rc": None, "stalled": True},
+        {"stdout": "", "stderr": "", "rc": None, "stalled": True,
+         "device_ok": False},  # parity stage: claim hangs
     ])
     assert final["value"] == 30000.0
     assert final["attempts"][1]["outcome"] == "hang-or-relay-wedge"
-    # remote rung NOT attempted after a stall (claim may be wedged)
     assert len(final["attempts"]) == 2
-    # safe rung skipped parity and the full rung never delivered it: the
-    # final JSON must say so explicitly, not look like parity was skipped
+    assert final["stages_skipped"] == "relay gone mid-ladder"
     assert final["kernel_parity_ok"] is False
-    assert "parity not run" in final["kernel_parity_error"]
+    assert list(final["stages"]) == ["parity"]
 
 
 def test_dead_relay_skips_all_tpu_rungs(bench, scripted):
@@ -156,25 +202,52 @@ def test_tuned_config_crash_falls_back_to_auto_probe(bench, scripted, tmp_path):
     assert final["value"] == 55000.0
 
 
-def test_full_rung_crash_after_emit_stamps_parity_death(bench, scripted):
+def test_full_rung_crash_after_emit_still_gets_stage_parity(bench, scripted):
+    # the rung no longer carries parity: even when the full rung dies right
+    # after its emit, the parity STAGE (own child, fresh claim) delivers
+    # the verdict
     final, _ = scripted([
-        {"stdout": _result_line(bench, 30000.0), "stderr": "backend up\ncompile+step in 30s"},
-        {"stdout": _result_line(bench, 65000.0),
+        {"stdout": _result_line(bench, 30000.0, platform="tpu"),
+         "stderr": "backend up\ncompile+step in 30s"},
+        {"stdout": _result_line(bench, 65000.0, platform="tpu"),
          "stderr": "backend up\ncompile+step in 31s\nboom", "rc": 1},
+        *_stage_children(),
     ])
     assert final["value"] == 65000.0
-    assert final["kernel_parity_ok"] is False
-    assert "died/stalled" in final["kernel_parity_error"]
-
-
-def test_slower_full_rung_donates_parity_to_safe_result(bench, scripted):
-    final, _ = scripted([
-        {"stdout": _result_line(bench, 60000.0), "stderr": "backend up\ncompile+step in 30s"},
-        {"stdout": _result_line(bench, 40000.0, kernel_parity_ok=True),
-         "stderr": "backend up\ncompile+step in 31s"},
-    ])
-    assert final["value"] == 60000.0
     assert final["kernel_parity_ok"] is True
+
+
+def test_conv_without_saved_params_drops_gauntlet_stage(bench, scripted):
+    # conv ran but could not persist params (e.g. deadline margin): the
+    # gauntlet child must not burn a fresh relay claim on a known-empty
+    # run; the 1b stage still runs
+    final, built = scripted([
+        {"stdout": _result_line(bench, 65000.0, platform="tpu"),
+         "stderr": "backend up\ncompile+step in 30s"},
+        {"stdout": _result_line(bench, 70000.0, platform="tpu"),
+         "stderr": "backend up\ncompile+step in 31s"},
+        {"stdout": _stage_line("parity"), "stderr": "backend up"},
+        {"stdout": _stage_line("conv", params_saved=False), "stderr": "backend up"},
+        {"stdout": _stage_line("1b"), "stderr": "backend up"},
+    ])
+    assert final["stages"]["gauntlet"]["outcome"].startswith("skipped")
+    assert final["stages"]["1b"]["ok"] is True
+    stage_cmds = [b["cmd"] for b in built[2:]]
+    assert [c[c.index("--stage") + 1] for c in stage_cmds] == [
+        "parity", "conv", "1b"]
+
+
+def test_failed_parity_stage_stamps_error(bench, scripted):
+    final, _ = scripted([
+        {"stdout": _result_line(bench, 60000.0, platform="tpu"),
+         "stderr": "backend up\ncompile+step in 30s"},
+        {"stdout": _result_line(bench, 40000.0, platform="tpu"),
+         "stderr": "backend up\ncompile+step in 31s"},
+        *_stage_children(parity_ok=False),
+    ])
+    assert final["value"] == 60000.0  # slower full rung: safe result kept
+    assert final["kernel_parity_ok"] is False
+    assert final["kernel_parity_error"]
 
 
 def test_service_sick_with_broken_local_mode_skips_auto_rung(bench, scripted):
