@@ -134,6 +134,43 @@ def test_convergence_slice_returns_params_and_gauntlet_scores(
     assert "icl/average" in out["scores"]
 
 
+def test_conv_slice_persists_params_for_cross_process_gauntlet(
+    bench, monkeypatch, tmp_path
+):
+    """In stage-orchestration mode (--stage conv) the trained params are
+    serialized atomically for the gauntlet stage's separate process, and
+    _load_slice_params round-trips them; without the env flag (inline
+    --run mode, in-memory handoff) nothing is written."""
+    import photon_tpu.config.schema as schema
+
+    monkeypatch.setattr(schema, "Config", _tiny_byte_cfg)
+    monkeypatch.setattr(
+        bench, "_corpus_tokens",
+        lambda: np.random.default_rng(0).integers(0, 250, 4000).astype(np.uint8),
+    )
+    params_path = tmp_path / ".conv_slice_params.msgpack"
+    monkeypatch.setattr(bench, "SLICE_PARAMS_PATH", params_path)
+    monkeypatch.setenv("PHOTON_BENCH_CONV_GBS", "2")
+    monkeypatch.setenv("PHOTON_BENCH_CONV_STEPS", "2")
+    monkeypatch.setenv("PHOTON_BENCH_MICROBATCH", "2")
+    monkeypatch.delenv("PHOTON_BENCH_CHILD_DEADLINE", raising=False)
+    monkeypatch.delenv("PHOTON_BENCH_FLASH_BLOCK", raising=False)
+    monkeypatch.delenv("PHOTON_BENCH_SAVE_SLICE_PARAMS", raising=False)
+
+    params = bench.tpu_convergence_slice(_FakeDev())
+    assert params is not None
+    assert not params_path.exists()  # inline mode: in-memory handoff only
+
+    monkeypatch.setenv("PHOTON_BENCH_SAVE_SLICE_PARAMS", "1")
+    bench.tpu_convergence_slice(_FakeDev())
+    assert params_path.exists()
+    restored = bench._load_slice_params()
+    np.testing.assert_array_equal(
+        np.asarray(restored["wte"]["embedding"]),
+        np.asarray(params["wte"]["embedding"]),
+    )
+
+
 def test_one_b_probe_predicted_vs_measured(bench, monkeypatch, tmp_path):
     import photon_tpu.config as config_mod
 
