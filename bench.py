@@ -68,6 +68,9 @@ PHOTON_BENCH_TRY_BLOCK (flash tile trial after the micro trials; default
 PHOTON_BENCH_SKIP_SWEEP=1 (skip the microbatch sweep),
 PHOTON_BENCH_PROFILE=1 (write a jax.profiler trace of the timed window),
 PHOTON_BENCH_ATTN (force attn_impl: xla|pallas — the safe rung uses xla),
+PHOTON_BENCH_CHUNK (pin the CE chunk size; set via bench_tuned.json
+"loss_chunk"), PHOTON_BENCH_TRY_CHUNK (CE-chunk trial after the tile
+trial; default 4096, or 0 — disabled — when PHOTON_BENCH_CHUNK pins one),
 PHOTON_BENCH_NO_CHUNK=1 (disable chunked CE — diagnostic only; unchunked
 peaks ~16.2 GiB at gbs 256, so no ladder rung uses it),
 PHOTON_BENCH_SKIP_STAGES=1 (skip the post-parity evidence stages),
@@ -158,6 +161,8 @@ def _tuned_env() -> dict:
         env["PHOTON_BENCH_REMAT"] = "1"
     if cfg.get("flash_block"):
         env["PHOTON_BENCH_FLASH_BLOCK"] = str(cfg["flash_block"])
+    if cfg.get("loss_chunk"):
+        env["PHOTON_BENCH_CHUNK"] = str(cfg["loss_chunk"])
     return env
 
 
@@ -166,6 +171,9 @@ _OOM_ENV = {
     "PHOTON_BENCH_CAP": "4",
     "PHOTON_BENCH_GBS": "64",
     "PHOTON_BENCH_SKIP_SWEEP": "1",
+    # no speculative CE-chunk growth on the rung that just proved
+    # memory-tight (the [chunk, vocab] logits buffer is gbs-independent)
+    "PHOTON_BENCH_TRY_CHUNK": "0",
 }
 
 
@@ -403,6 +411,9 @@ def supervise() -> int:
             if result.get("microbatch"):
                 env.setdefault("PHOTON_BENCH_MICROBATCH",
                                str(result["microbatch"]))
+            if result.get("loss_chunk_tokens"):
+                env.setdefault("PHOTON_BENCH_CHUNK",
+                               str(result["loss_chunk_tokens"]))
             cmd = [sys.executable, str(pathlib.Path(__file__).resolve()),
                    "--stage", stage, "--platform", "tpu"]
             log(f"stage {stage}: spawning (hard {tmo}s)")
@@ -466,6 +477,7 @@ def supervise() -> int:
         "PHOTON_BENCH_MICROBATCH": "2",
         "PHOTON_BENCH_SKIP_SWEEP": "1",
         "PHOTON_BENCH_SECOND_MICRO": "0",
+        "PHOTON_BENCH_TRY_CHUNK": "0",
         "PHOTON_BENCH_SKIP_PARITY": "1",
         "PHOTON_BENCH_SKIP_STAGES": "1",
         "PHOTON_BENCH_STEPS": "4",
@@ -490,6 +502,9 @@ def supervise() -> int:
         if banked is None and safe_rec["outcome"] == "oom":
             env = dict(env, **_OOM_ENV)
             env.pop("PHOTON_BENCH_MICROBATCH", None)
+            # the [chunk, vocab] logits buffer is gbs-independent — a
+            # pinned large chunk must not ride the reduced-config retry
+            env.pop("PHOTON_BENCH_CHUNK", None)
             log(f"safe rung OOMed: full rungs with reduced config {_OOM_ENV}")
         local_env = dict(env, PALLAS_AXON_REMOTE_COMPILE="0")
         full, full_rec = run_rung("tpu-full-local", "tpu", 1800, local_env)
@@ -511,6 +526,7 @@ def supervise() -> int:
                 # (remat on, smaller cap/batch, microbatch re-probed)
                 oom_env = dict(env, **_OOM_ENV, **mode)
                 oom_env.pop("PHOTON_BENCH_MICROBATCH", None)
+                oom_env.pop("PHOTON_BENCH_CHUNK", None)
                 full, full_rec = run_rung("tpu-full-oom-reduced", "tpu", 1200,
                                           oom_env)
             elif full_rec["outcome"] != "dead-relay" \
@@ -781,6 +797,11 @@ def tpu_convergence_slice(dev) -> dict | None:
         if blk:
             cfg.model.flash_block_q = blk
             cfg.model.flash_block_k = blk
+        # run at the winning rung's CE chunk too (the supervisor forwards
+        # the banked result's config into stage children)
+        chunk_env = os.environ.get("PHOTON_BENCH_CHUNK", "")
+        if chunk_env.isdigit() and int(chunk_env) > 0:
+            cfg.train.loss_chunk_tokens = int(chunk_env)
         gbs = int(os.environ.get("PHOTON_BENCH_CONV_GBS", "32"))
         micro = int(os.environ.get("PHOTON_BENCH_MICROBATCH", "0") or 0) or 2
         cfg.train.global_batch_size = gbs
@@ -1176,6 +1197,14 @@ def run(platform: str) -> None:
         # peaks ~16.2 GiB at gbs 256 (OOM-tight on 16 GB; see
         # scripts/aot_compile_check.py matrix in PERF.md)
         cfg.train.loss_chunk_tokens = 0
+    pin_chunk = os.environ.get("PHOTON_BENCH_CHUNK", "")
+    if pin_chunk.isdigit() and int(pin_chunk) > 0 \
+            and cfg.train.loss_chunk_tokens:
+        cfg.train.loss_chunk_tokens = int(pin_chunk)
+    else:
+        # "0"/garbage is NOT a disable switch (that's PHOTON_BENCH_NO_CHUNK):
+        # treat it as no-pin so the trial default stays active
+        pin_chunk = ""
     tuned_block = int(os.environ.get("PHOTON_BENCH_FLASH_BLOCK", "0"))
     if tuned_block:
         cfg.model.flash_block_q = tuned_block
@@ -1305,6 +1334,33 @@ def run(platform: str) -> None:
     # second emit below upgrades it with kernel_parity_ok)
     emit(out)
 
+    def upgrade_trial(label: str, micro_c: int, mutate, out_extra: dict) -> bool:
+        """Time one post-emit candidate config; keep it (re-emit ``out``
+        merged with ``out_extra``) when faster, free its HBM otherwise."""
+        nonlocal trainer, micro, toks_per_sec, loss, mfu
+        cand = try_candidate(micro_c, n_timed=n_steps, free_current_first=True,
+                             mutate=mutate)
+        if cand is None:
+            return False
+        t_c, dt_c, loss_c = cand
+        tps_c = n_steps * gbs * seq / dt_c
+        log(f"{label}: {tps_c:,.0f} tok/s vs {toks_per_sec:,.0f}")
+        if tps_c <= toks_per_sec:
+            t_c.state = None
+            return False
+        trainer, micro = t_c, micro_c
+        toks_per_sec, loss = tps_c, loss_c
+        mfu = toks_per_sec * flops_per_tok / peak
+        out.update({
+            "value": round(toks_per_sec, 1),
+            "vs_baseline": round(toks_per_sec / A100_EST_TOKENS_PER_SEC, 4),
+            "mfu": round(mfu, 4),
+            "final_loss": round(loss, 3),
+            **out_extra,
+        })
+        emit(out)
+        return True
+
     # Pinned-config micro trial: bench_tuned.json pins micro=2 from the
     # PRE-chunked-CE hardware session, where the [micro·2047, vocab] fp32
     # logits made small microbatches faster. Chunked CE removed that sink,
@@ -1314,31 +1370,12 @@ def run(platform: str) -> None:
     if on_tpu and pinned and second != "0":
         micro2 = int(second) if second else 2 * micro
         if micro2 != micro and gbs % micro2 == 0:
-            cand = try_candidate(micro2, n_timed=n_steps, free_current_first=True)
-            if cand is not None:
-                t2, dt2, loss2 = cand
-                tps2 = n_steps * gbs * seq / dt2
-                log(f"second-micro trial: micro={micro2}: {tps2:,.0f} tok/s "
-                    f"vs micro={micro}: {toks_per_sec:,.0f}")
-                if tps2 > toks_per_sec:
-                    trainer, micro = t2, micro2
-                    toks_per_sec, loss = tps2, loss2
-                    mfu = toks_per_sec * flops_per_tok / peak
-                    out.update({
-                        "value": round(toks_per_sec, 1),
-                        "vs_baseline": round(toks_per_sec / A100_EST_TOKENS_PER_SEC, 4),
-                        "mfu": round(mfu, 4),
-                        "microbatch": micro,
-                        "final_loss": round(loss, 3),
-                    })
-                    emit(out)
-                else:
-                    t2.state = None
-                    del t2
+            upgrade_trial(f"second-micro trial: micro={micro2}", micro2,
+                          None, {"microbatch": micro2})
 
-    # Flash tile trial (PERF.md lever 2): 512x512 blocks halve the number of
+    # Flash tile trial (PERF.md lever 2): larger blocks cut the number of
     # grid steps at seq 2048; worth one compile once a result is safe.
-    # when the tuned config already pins a measured-winner tile, default the
+    # When the tuned config already pins a measured-winner tile, default the
     # trial OFF (the 256→512→1024 ladder was measured on-chip round 5;
     # 2048 is compile-rejected: scoped-vmem 23M > 16M)
     block = int(os.environ.get("PHOTON_BENCH_TRY_BLOCK",
@@ -1349,27 +1386,26 @@ def run(platform: str) -> None:
             c.model.flash_block_q = b
             c.model.flash_block_k = b
 
-        cand = try_candidate(micro, n_timed=n_steps, free_current_first=True,
-                             mutate=_blocks)
-        if cand is not None:
-            t3, dt3, loss3 = cand
-            tps3 = n_steps * gbs * seq / dt3
-            log(f"block-{block} trial: {tps3:,.0f} tok/s vs {toks_per_sec:,.0f}")
-            if tps3 > toks_per_sec:
-                trainer = t3
-                toks_per_sec, loss = tps3, loss3
-                mfu = toks_per_sec * flops_per_tok / peak
-                out.update({
-                    "value": round(toks_per_sec, 1),
-                    "vs_baseline": round(toks_per_sec / A100_EST_TOKENS_PER_SEC, 4),
-                    "mfu": round(mfu, 4),
-                    "flash_block": block,
-                    "final_loss": round(loss, 3),
-                })
-                emit(out)
-            else:
-                t3.state = None
-                del t3
+        upgrade_trial(f"block-{block} trial", micro, _blocks,
+                      {"flash_block": block})
+
+    # CE-chunk trial: the loss path was the #1 HBM sink pre-chunking;
+    # bigger chunks mean fewer, larger lm-head matmuls (4096/8192
+    # AOT-verified at 9.7/11.3 GiB — scripts/aot_compile_check.py).
+    # Defaults off when a measured pin exists (bench_tuned.json loss_chunk).
+    chunk = int(os.environ.get("PHOTON_BENCH_TRY_CHUNK",
+                               "0" if pin_chunk else "4096"))
+    if on_tpu and chunk and cfg.train.loss_chunk_tokens \
+            and chunk != cfg.train.loss_chunk_tokens:
+        def _chunk(c, n=chunk, b=out["flash_block"]):
+            c.train.loss_chunk_tokens = n
+            # carry the winning flash tile into the candidate config (the
+            # block trial mutates only its own copy, never `cfg`)
+            c.model.flash_block_q = b
+            c.model.flash_block_k = b
+
+        upgrade_trial(f"chunk-{chunk} trial", micro, _chunk,
+                      {"loss_chunk_tokens": chunk})
 
     # under the supervisor (PHOTON_BENCH_ORCHESTRATED) parity and the
     # evidence stages run in their own child processes with fresh relay
