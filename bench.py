@@ -397,10 +397,25 @@ def supervise() -> int:
             SLICE_PARAMS_PATH.unlink(missing_ok=True)  # no stale params
         stage_recs = result.setdefault("stages", {})
         skip: set[str] = set()
+        # soft wall-clock budget for the whole stage phase: stages that
+        # stall AFTER device contact (per-claim wedge) each burn a watchdog
+        # window — don't let four of them stack on top of the rung time
+        budget = float(os.environ.get("PHOTON_BENCH_STAGE_BUDGET", "2400"))
+        t_stages = time.monotonic()
         for stage, tmo in stages:
             if stage in skip:
                 stage_recs[stage] = {
                     "ok": False, "outcome": "skipped: conv saved no params"}
+                continue
+            if time.monotonic() - t_stages >= budget:
+                stage_recs[stage] = {
+                    "ok": False, "outcome": "skipped: stage budget exhausted"}
+                if stage == "parity" and "kernel_parity_ok" not in result:
+                    # stamped-false-not-absent invariant: an unverified
+                    # result must not read as "parity not requested"
+                    result["kernel_parity_ok"] = False
+                    result["kernel_parity_error"] = "parity stage skipped: " \
+                        "stage budget exhausted"
                 continue
             env = dict(os.environ)
             env["PHOTON_BENCH_CHILD_DEADLINE"] = str(time.time() + tmo - 60)

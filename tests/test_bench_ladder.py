@@ -32,7 +32,8 @@ def bench(monkeypatch, tmp_path):
                 "PHOTON_BENCH_FLASH_BLOCK", "PHOTON_BENCH_SKIP_PARITY",
                 "PHOTON_BENCH_SKIP_STAGES", "PHOTON_BENCH_CONV",
                 "PHOTON_BENCH_GAUNTLET", "PHOTON_BENCH_1B",
-                "PHOTON_BENCH_SAVE_SLICE_PARAMS"):
+                "PHOTON_BENCH_SAVE_SLICE_PARAMS", "PHOTON_BENCH_STAGE_BUDGET",
+                "PHOTON_BENCH_CHUNK", "PHOTON_BENCH_TRY_CHUNK"):
         monkeypatch.delenv(var, raising=False)
     return mod
 
@@ -235,6 +236,24 @@ def test_conv_without_saved_params_drops_gauntlet_stage(bench, scripted):
     stage_cmds = [b["cmd"] for b in built[2:]]
     assert [c[c.index("--stage") + 1] for c in stage_cmds] == [
         "parity", "conv", "1b"]
+
+
+def test_stage_budget_zero_skips_all_stages(bench, scripted, monkeypatch):
+    # per-claim wedges AFTER device contact each burn a watchdog window;
+    # the soft stage budget stops them stacking on top of the rung time
+    monkeypatch.setenv("PHOTON_BENCH_STAGE_BUDGET", "0")
+    final, built = scripted([
+        {"stdout": _result_line(bench, 65000.0, platform="tpu"),
+         "stderr": "backend up\ncompile+step in 30s"},
+        {"stdout": _result_line(bench, 70000.0, platform="tpu"),
+         "stderr": "backend up\ncompile+step in 31s"},
+    ])
+    assert len(built) == 2  # no stage children spawned
+    assert all(rec["outcome"].startswith("skipped: stage budget")
+               for rec in final["stages"].values())
+    # stamped-false-not-absent: an unverified result must say so
+    assert final["kernel_parity_ok"] is False
+    assert "budget" in final["kernel_parity_error"]
 
 
 def test_failed_parity_stage_stamps_error(bench, scripted):
