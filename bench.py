@@ -573,7 +573,12 @@ def supervise() -> int:
         # carries the last interactively measured on-chip result (with its
         # own timestamp) so a degraded round still points at TPU evidence
         try:
-            prior = max(HERE.glob("BENCH_interactive_r*.json"))
+            import re as _re
+
+            prior = max(
+                HERE.glob("BENCH_interactive_r*.json"),
+                key=lambda p: int(_re.search(r"_r(\d+)", p.stem).group(1)),
+            )
             prior_res = json.loads(prior.read_text().splitlines()[-1])
             if prior_res.get("platform") == "tpu":
                 result["prior_onchip"] = {

@@ -125,18 +125,21 @@ class MeshConfig:
 
     Axes follow the TPU-idiomatic layout: ``data`` (batch DP), ``fsdp``
     (weight sharding / ZeRO-3), ``tensor`` (TP), ``sequence`` (context
-    parallel / ring attention). The reference's DDP/FSDP/TP knobs
-    (``trainer_utils.py:1640-1720``) map onto mesh axis sizes here.
+    parallel / ring attention), ``pipe`` (pipeline parallel — GPipe-style
+    stage schedule, ``parallel/pipeline.py``). The reference's DDP/FSDP/TP
+    knobs (``trainer_utils.py:1640-1720``) map onto mesh axis sizes here;
+    sequence and pipe have no reference analog.
     """
 
     data: int = 1
     fsdp: int = 1
     tensor: int = 1
     sequence: int = 1
+    pipe: int = 1
 
     @property
     def size(self) -> int:
-        return self.data * self.fsdp * self.tensor * self.sequence
+        return self.data * self.fsdp * self.tensor * self.sequence * self.pipe
 
     def axis_sizes(self) -> dict[str, int]:
         return {
@@ -144,6 +147,7 @@ class MeshConfig:
             "fsdp": self.fsdp,
             "tensor": self.tensor,
             "sequence": self.sequence,
+            "pipe": self.pipe,
         }
 
 
@@ -318,6 +322,43 @@ class Config:
             raise ValueError("global_batch_size must be divisible by device_microbatch_size")
         StrategyName(self.fl.strategy_name)
         AttnImpl(self.model.attn_impl)
+        if self.mesh.pipe > 1:
+            if self.train.device_microbatch_size == "auto":
+                raise ValueError(
+                    "device_microbatch_size='auto' is not supported with "
+                    "mesh.pipe > 1 (the OOM probe builds the non-pipelined "
+                    "step); set an explicit microbatch size"
+                )
+            if self.model.n_layers % self.mesh.pipe:
+                raise ValueError(
+                    f"n_layers={self.model.n_layers} must divide evenly into "
+                    f"mesh.pipe={self.mesh.pipe} stages"
+                )
+            if self.mesh.sequence > 1:
+                raise ValueError(
+                    "mesh.pipe > 1 with mesh.sequence > 1 is not supported: "
+                    "ring attention's shard_map cannot nest inside the "
+                    "pipeline's manual pipe axis"
+                )
+            if self.mesh.data > 1 and self.mesh.fsdp > 1:
+                raise ValueError(
+                    "mesh.pipe > 1 supports at most one batch axis > 1 "
+                    "(data OR fsdp): the compound (data, fsdp) batch "
+                    "sharding inside the partial-manual pipeline region "
+                    "hits an XLA SPMD partitioner CHECK failure "
+                    "(spmd_partitioner_util.cc group-count assertion). "
+                    "Fold the batch parallelism into one axis, e.g. "
+                    "fsdp=data*fsdp, data=1"
+                )
+            if self.model.attn_impl == AttnImpl.PALLAS.value:
+                # the pallas dispatch shard_maps over batch/head axes, which
+                # cannot nest inside the pipeline's partial-manual region
+                warnings.warn(
+                    "mesh.pipe > 1 with attn_impl=pallas: falling back to "
+                    "attn_impl=xla inside pipeline stages",
+                    stacklevel=2,
+                )
+                self.model.attn_impl = AttnImpl.XLA.value
         if self.mesh.sequence > 1 and self.model.attn_impl == AttnImpl.PALLAS.value:
             # a sequence-sharded mesh needs the ring (context-parallel)
             # dispatch: the plain pallas call sees sequence-sharded operands

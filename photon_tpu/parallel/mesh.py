@@ -11,6 +11,8 @@ Axes (SURVEY.md §2.3 mapping):
 - ``fsdp``     — parameter/optimizer sharding (ZeRO-3 / FULL_SHARD analog)
 - ``tensor``   — tensor parallel (TP layer-plan analog)
 - ``sequence`` — context parallel (no reference analog; ring attention)
+- ``pipe``     — pipeline parallel (no reference analog; GPipe-style stage
+  schedule over ``ppermute`` — ``parallel/pipeline.py``)
 """
 
 from __future__ import annotations
@@ -21,17 +23,19 @@ from jax.sharding import Mesh
 
 from photon_tpu.config.schema import MeshConfig
 
-AXES = ("data", "fsdp", "tensor", "sequence")
+AXES = ("data", "fsdp", "tensor", "sequence", "pipe")
 
 
 def make_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     if cfg.size > len(devices):
         raise ValueError(f"mesh needs {cfg.size} devices, have {len(devices)}")
-    devs = np.asarray(devices[: cfg.size]).reshape(cfg.data, cfg.fsdp, cfg.tensor, cfg.sequence)
+    devs = np.asarray(devices[: cfg.size]).reshape(
+        cfg.data, cfg.fsdp, cfg.tensor, cfg.sequence, cfg.pipe
+    )
     return Mesh(devs, AXES)
 
 
 def single_device_mesh(device=None) -> Mesh:
     device = device or jax.devices()[0]
-    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1), AXES)
+    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1, 1), AXES)

@@ -29,28 +29,37 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # ordered (path-regex, spec) rules; first match wins. Specs are written for
-# the [L, in, out] stacked-block layout; non-block params are 1-2D.
+# the [L, in, out] stacked-block layout; non-block params are 1-2D. The
+# leading layer axis of every in-block param is sharded over ``pipe`` —
+# each pipeline stage owns its contiguous slab of layers (a no-op at
+# pipe=1, the default).
 _RULES: list[tuple[str, P]] = [
     (r"wte/embedding$", P("fsdp", "tensor")),
     (r"^wpe$", P(None, "fsdp")),
-    (r"(wqkv|up_proj|gate_proj|q_proj|k_proj|v_proj)/kernel$", P(None, "fsdp", "tensor")),
-    (r"(out_proj|down_proj)/kernel$", P(None, "tensor", "fsdp")),
-    (r"(wqkv|up_proj|gate_proj|q_proj|k_proj|v_proj)/bias$", P(None, "tensor")),
-    (r"(out_proj|down_proj)/bias$", P(None, "fsdp")),
+    (r"(wqkv|up_proj|gate_proj|q_proj|k_proj|v_proj)/kernel$", P("pipe", "fsdp", "tensor")),
+    (r"(out_proj|down_proj)/kernel$", P("pipe", "tensor", "fsdp")),
+    (r"(wqkv|up_proj|gate_proj|q_proj|k_proj|v_proj)/bias$", P("pipe", "tensor")),
+    (r"(out_proj|down_proj)/bias$", P("pipe", "fsdp")),
     (r"lm_head/kernel$", P("tensor", "fsdp")),
-    (r"(ln_1|ln_2|ln_f)/(scale|bias)$", P()),
+    (r"(ln_1|ln_2)/(scale|bias)$", P("pipe")),
+    (r"ln_f/(scale|bias)$", P()),
 ]
 
 
 def _fit_spec(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
-    """Drop axes that don't divide the dimension (or overflow rank)."""
+    """Drop axes that don't divide the dimension (or overflow rank), and
+    axes the mesh doesn't have (a spec can't shard over a missing axis)."""
     out = []
     for i, dim in enumerate(shape):
         axis = spec[i] if i < len(spec) else None
         if axis is None:
             out.append(None)
             continue
-        axis_size = int(np.prod([mesh.shape[a] for a in (axis if isinstance(axis, tuple) else (axis,))]))
+        names = axis if isinstance(axis, tuple) else (axis,)
+        if any(a not in mesh.shape for a in names):
+            out.append(None)
+            continue
+        axis_size = int(np.prod([mesh.shape[a] for a in names]))
         out.append(axis if dim % axis_size == 0 else None)
     return P(*out)
 

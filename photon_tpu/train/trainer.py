@@ -139,11 +139,22 @@ class Trainer:
         if probed_step is not None:
             jitted_train = probed_step  # reuse the winner's compile
         else:
-            jitted_train = jax.jit(
-                make_train_step(
+            if self.mesh.shape.get("pipe", 1) > 1:
+                # GPipe-style stage schedule over the pipe axis; same
+                # TrainState/sharding/checkpoint layout, different step fn
+                from photon_tpu.parallel.pipeline import make_pipeline_train_step
+
+                step_fn = make_pipeline_train_step(
+                    self.model, self.tx, self.mesh, n_microbatches=n_micro,
+                    loss_chunk_tokens=cfg.train.loss_chunk_tokens,
+                )
+            else:
+                step_fn = make_train_step(
                     self.model, self.tx, n_microbatches=n_micro,
                     loss_chunk_tokens=cfg.train.loss_chunk_tokens,
-                ),
+                )
+            jitted_train = jax.jit(
+                step_fn,
                 in_shardings=(self._shardings, self._batch_sharding),
                 out_shardings=(self._shardings, None),
                 donate_argnums=0,

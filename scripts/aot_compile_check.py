@@ -18,7 +18,7 @@ dryrun, and obtainable with zero chips.
 Usage:
     python scripts/aot_compile_check.py [--micro 2] [--gbs 256] [--impl pallas]
         [--block 256] [--chunk 2048] [--remat] [--layers N] [--seq N]
-        [--preset mpt-1b] [--mesh data=1,fsdp=4,tensor=1,sequence=1]
+        [--preset mpt-1b] [--mesh data=1,fsdp=4,tensor=1,sequence=1,pipe=1]
         [--topo v5e:2x2x1]
 
 Prints one JSON line: {"ok", "lower_s", "compile_s", "hbm_gib", ...}.
@@ -146,7 +146,7 @@ def main() -> int:
     from photon_tpu.parallel.mesh import make_mesh
     from photon_tpu.parallel.sharding import batch_spec, state_shardings
 
-    axes = {"data": 1, "fsdp": 1, "tensor": 1, "sequence": 1}
+    axes = {"data": 1, "fsdp": 1, "tensor": 1, "sequence": 1, "pipe": 1}
     if args.mesh:
         for kv in args.mesh.split(","):
             k, _, v = kv.partition("=")
@@ -186,6 +186,15 @@ def main() -> int:
         step = make_eval_step(model, loss_chunk_tokens=args.chunk)
         jitted = jax.jit(step)
         jit_args = (state.params, tok)
+    elif axes["pipe"] > 1:
+        from photon_tpu.parallel.pipeline import make_pipeline_train_step
+
+        step = make_pipeline_train_step(
+            model, tx, mesh, n_microbatches=args.gbs // rows_per_scan,
+            loss_chunk_tokens=args.chunk,
+        )
+        jitted = jax.jit(step, donate_argnums=0)
+        jit_args = (state, tok)
     else:
         step = make_train_step(
             model, tx, n_microbatches=args.gbs // rows_per_scan,

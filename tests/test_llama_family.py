@@ -174,9 +174,9 @@ def test_llama_sharding_specs_mlp_projections():
     up = specs["blocks"]["block"]["up_proj"]["kernel"]
     gate = specs["blocks"]["block"]["gate_proj"]["kernel"]
     down = specs["blocks"]["block"]["down_proj"]["kernel"]
-    assert up == jax.sharding.PartitionSpec(None, "fsdp", "tensor")
-    assert gate == jax.sharding.PartitionSpec(None, "fsdp", "tensor")
-    assert down == jax.sharding.PartitionSpec(None, "tensor", "fsdp")
+    assert up == jax.sharding.PartitionSpec("pipe", "fsdp", "tensor")
+    assert gate == jax.sharding.PartitionSpec("pipe", "fsdp", "tensor")
+    assert down == jax.sharding.PartitionSpec("pipe", "tensor", "fsdp")
     assert specs["lm_head"]["kernel"] == jax.sharding.PartitionSpec("tensor", "fsdp")
 
 

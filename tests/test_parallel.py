@@ -32,7 +32,8 @@ def _cfg(mesh: MeshConfig) -> Config:
 
 def test_mesh_axes_and_size():
     mesh = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2, sequence=1))
-    assert mesh.shape == {"data": 2, "fsdp": 2, "tensor": 2, "sequence": 1}
+    assert mesh.shape == {"data": 2, "fsdp": 2, "tensor": 2, "sequence": 1,
+                          "pipe": 1}
     with pytest.raises(ValueError):
         make_mesh(MeshConfig(data=16))
 
@@ -42,10 +43,12 @@ def test_param_specs_rules():
     params = init_params(TINY, seed=0)
     specs = param_specs(params, mesh)
     blk = specs["blocks"]["block"]
-    assert blk["wqkv"]["kernel"] == P(None, "fsdp", "tensor")
-    assert blk["out_proj"]["kernel"] == P(None, "tensor", "fsdp")
+    # leading layer axis carries the pipe-stage sharding (a no-op at pipe=1)
+    assert blk["wqkv"]["kernel"] == P("pipe", "fsdp", "tensor")
+    assert blk["out_proj"]["kernel"] == P("pipe", "tensor", "fsdp")
     assert specs["wte"]["embedding"] == P("fsdp", "tensor")
-    assert all(a is None for a in specs["blocks"]["block"]["ln_1"]["scale"])  # replicated
+    assert blk["ln_1"]["scale"] == P("pipe", None)  # per-layer scales ride the slab
+    assert all(a is None for a in specs["ln_f"]["scale"])  # replicated
 
 
 def test_spec_drops_indivisible_axes():

@@ -1,0 +1,143 @@
+"""Pipeline parallelism (``parallel/pipeline.py``): the GPipe-style stage
+schedule over the ``pipe`` mesh axis must produce the SAME loss and
+gradients as the non-pipelined grad-accumulation step — pipelining is an
+execution schedule, not a numerical change. No reference analog (the
+reference's in-client parallelism is DDP/FSDP/TP via Composer,
+``trainer_utils.py:1640-1720``); equivalence is checked against this
+repo's own ``make_train_step``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding
+
+from photon_tpu.config.schema import Config, MeshConfig
+from photon_tpu.models.mpt import MPTModel, init_params
+from photon_tpu.parallel.mesh import make_mesh
+from photon_tpu.parallel.pipeline import make_pipeline_train_step
+from photon_tpu.parallel.sharding import batch_spec, state_shardings
+from photon_tpu.train.train_step import (
+    init_train_state,
+    make_loss_fn,
+    make_train_step,
+)
+
+
+def _cfg(mesh: MeshConfig, **model_overrides) -> Config:
+    cfg = Config()
+    cfg.model.d_model = 32
+    cfg.model.n_layers = 4
+    cfg.model.n_heads = 2
+    cfg.model.max_seq_len = 16
+    cfg.model.vocab_size = 64
+    cfg.model.attn_impl = "xla"
+    cfg.model.compute_dtype = "float32"
+    for k, v in model_overrides.items():
+        setattr(cfg.model, k, v)
+    cfg.mesh = mesh
+    cfg.train.global_batch_size = 8
+    cfg.train.device_microbatch_size = 2
+    return cfg.validate()
+
+
+def _pipeline_grads(cfg, params, tokens, n_micro, chunk):
+    """One sgd(lr=1) pipeline step: params_before - params_after == grads."""
+    model = MPTModel(cfg.model)
+    mesh = make_mesh(cfg.mesh)
+    tx = optax.sgd(1.0)
+    state = init_train_state(model, tx, params)
+    sh = state_shardings(state, mesh)
+    state = jax.tree.map(lambda l, s: jax.device_put(l, s), state, sh)
+    bs = NamedSharding(mesh, batch_spec(mesh))
+    step = jax.jit(
+        make_pipeline_train_step(
+            model, tx, mesh, n_microbatches=n_micro, loss_chunk_tokens=chunk
+        ),
+        in_shardings=(sh, bs), out_shardings=(sh, None),
+    )
+    new_state, metrics = step(state, jax.device_put(tokens, bs))
+    grads = jax.tree.map(
+        lambda a, b: jnp.asarray(a) - b, params, jax.device_get(new_state.params)
+    )
+    return grads, float(metrics["loss"])
+
+
+def _reference_grads(cfg, params, tokens, n_micro, chunk):
+    model = MPTModel(cfg.model)
+    lf = make_loss_fn(model, chunk)
+
+    def loss(p):
+        m = tokens.reshape(n_micro, tokens.shape[0] // n_micro, tokens.shape[1])
+        return sum(lf(p, m[i]) for i in range(n_micro)) / n_micro
+
+    return jax.grad(loss)(params), float(loss(params))
+
+
+@pytest.mark.parametrize(
+    "mesh,chunk",
+    [
+        (MeshConfig(data=2, pipe=4), 2048),  # pipe x data, chunked CE
+        (MeshConfig(pipe=2, fsdp=2), 2048),  # pipe x fsdp (auto inside)
+        (MeshConfig(data=2, pipe=4), 0),     # unchunked tail path
+    ],
+)
+def test_pipeline_matches_reference_grads(mesh, chunk):
+    cfg = _cfg(mesh)
+    params = init_params(cfg.model, seed=0)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+    g_pipe, loss_pipe = _pipeline_grads(cfg, params, tokens, 2, chunk)
+    g_ref, loss_ref = _reference_grads(cfg, params, tokens, 2, chunk)
+    assert abs(loss_pipe - loss_ref) < 1e-5
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5), g_pipe, g_ref
+    )
+
+
+def test_pipeline_matches_with_remat_and_llama_family():
+    """Remat inside stages + the llama knobs (RoPE/RMSNorm/SwiGLU/GQA)
+    flow through MPTBlock reuse unchanged."""
+    cfg = _cfg(
+        MeshConfig(data=2, pipe=2),
+        remat=True, rope=True, norm="rmsnorm", mlp="swiglu",
+        n_kv_heads=1, tie_embeddings=False, learned_pos_emb=False,
+    )
+    params = init_params(cfg.model, seed=0)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, 64)
+    g_pipe, loss_pipe = _pipeline_grads(cfg, params, tokens, 4, 2048)
+    g_ref, loss_ref = _reference_grads(cfg, params, tokens, 4, 2048)
+    assert abs(loss_pipe - loss_ref) < 1e-5
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5), g_pipe, g_ref
+    )
+
+
+def test_pipeline_validation():
+    with pytest.raises(ValueError, match="divide evenly"):
+        _cfg(MeshConfig(pipe=3))  # 4 layers % 3 stages
+    with pytest.raises(ValueError, match="sequence"):
+        _cfg(MeshConfig(pipe=2, sequence=2))
+    with pytest.raises(ValueError, match="one batch axis"):
+        # compound (data, fsdp) batch sharding under manual pipe trips an
+        # XLA SPMD partitioner CHECK failure — rejected at validation
+        _cfg(MeshConfig(data=2, fsdp=2, pipe=2))
+    with pytest.warns(UserWarning, match="falling back to"):
+        cfg = _cfg(MeshConfig(pipe=2), attn_impl="pallas")
+    assert cfg.model.attn_impl == "xla"
+
+
+def test_trainer_runs_pipelined():
+    """Trainer picks the pipeline step for pipe>1 meshes; loss falls on a
+    repeated batch and the state layout (checkpoint format) is unchanged."""
+    from photon_tpu.train.trainer import Trainer
+
+    cfg = _cfg(MeshConfig(data=2, pipe=2))
+    trainer = Trainer(cfg, mesh=make_mesh(cfg.mesh), init_seed=0)
+    tokens = np.random.default_rng(0).integers(0, 64, (8, 16), dtype=np.int32)
+    losses = []
+    for _ in range(8):
+        trainer.state, m = trainer._train_step(trainer.state, tokens)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
