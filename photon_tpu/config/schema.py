@@ -65,8 +65,14 @@ class ModelConfig:
     n_kv_heads: int = 0  # grouped-query attention; 0 -> n_heads (MHA)
     norm: str = "layernorm"  # layernorm | rmsnorm (both fp32)
     norm_eps: float = 1.0e-5  # checkpoint-interop-sensitive (rms_norm_eps)
-    mlp: str = "gelu"  # gelu | swiglu (fused gate+up projection)
+    mlp: str = "gelu"  # gelu | swiglu | moe (expert-parallel, ops/moe.py)
     mlp_hidden_size: int = 0  # 0 -> expansion_ratio * d_model
+    # MoE knobs (mlp == "moe"): GShard dense dispatch with static capacity;
+    # experts shard over the `expert` mesh axis
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01  # Switch load-balance loss weight
     attn_impl: str = AttnImpl.PALLAS.value
     # Numerics: params kept fp32, compute in bf16 (reference: amp_bf16 + FSDP
     # PURE mixed precision, ``mpt-125m.yaml:85-92``).
@@ -126,9 +132,10 @@ class MeshConfig:
     Axes follow the TPU-idiomatic layout: ``data`` (batch DP), ``fsdp``
     (weight sharding / ZeRO-3), ``tensor`` (TP), ``sequence`` (context
     parallel / ring attention), ``pipe`` (pipeline parallel — GPipe-style
-    stage schedule, ``parallel/pipeline.py``). The reference's DDP/FSDP/TP
-    knobs (``trainer_utils.py:1640-1720``) map onto mesh axis sizes here;
-    sequence and pipe have no reference analog.
+    stage schedule, ``parallel/pipeline.py``), ``expert`` (MoE expert
+    parallel, ``ops/moe.py``). The reference's DDP/FSDP/TP knobs
+    (``trainer_utils.py:1640-1720``) map onto mesh axis sizes here;
+    sequence, pipe, and expert have no reference analog.
     """
 
     data: int = 1
@@ -136,10 +143,12 @@ class MeshConfig:
     tensor: int = 1
     sequence: int = 1
     pipe: int = 1
+    expert: int = 1
 
     @property
     def size(self) -> int:
-        return self.data * self.fsdp * self.tensor * self.sequence * self.pipe
+        return (self.data * self.fsdp * self.tensor * self.sequence
+                * self.pipe * self.expert)
 
     def axis_sizes(self) -> dict[str, int]:
         return {
@@ -148,6 +157,7 @@ class MeshConfig:
             "tensor": self.tensor,
             "sequence": self.sequence,
             "pipe": self.pipe,
+            "expert": self.expert,
         }
 
 
@@ -379,8 +389,26 @@ class Config:
             raise ValueError("rope excludes alibi and learned_pos_emb")
         if self.model.norm not in ("layernorm", "rmsnorm"):
             raise ValueError(f"bad model.norm {self.model.norm}")
-        if self.model.mlp not in ("gelu", "swiglu"):
+        if self.model.mlp not in ("gelu", "swiglu", "moe"):
             raise ValueError(f"bad model.mlp {self.model.mlp}")
+        if self.model.mlp == "moe":
+            if self.model.moe_num_experts < 2:
+                raise ValueError("mlp='moe' needs moe_num_experts >= 2")
+            if not 1 <= self.model.moe_top_k <= self.model.moe_num_experts:
+                raise ValueError("moe_top_k must be in [1, moe_num_experts]")
+            if self.mesh.expert > 1 \
+                    and self.model.moe_num_experts % self.mesh.expert:
+                raise ValueError(
+                    f"moe_num_experts={self.model.moe_num_experts} must be "
+                    f"divisible by mesh.expert={self.mesh.expert}"
+                )
+            if self.mesh.pipe > 1 or self.mesh.sequence > 1:
+                raise ValueError(
+                    "mlp='moe' composes with data/fsdp/tensor/expert mesh "
+                    "axes; pipe and sequence are not supported with MoE yet"
+                )
+        elif self.mesh.expert > 1:
+            raise ValueError("mesh.expert > 1 requires model.mlp='moe'")
         if self.model.rope and self.model.d_head % 2:
             raise ValueError("rope needs an even d_head")
         if self.model.n_kv_heads < 0 or self.model.mlp_hidden_size < 0:

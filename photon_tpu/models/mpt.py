@@ -53,7 +53,7 @@ def _constrain_logits(logits: jax.Array) -> jax.Array:
 
     from photon_tpu.parallel.sharding import _fit_spec
 
-    spec = _fit_spec(P(("data", "fsdp"), "sequence", "tensor"), logits.shape, mesh)
+    spec = _fit_spec(P(("data", "fsdp", "expert"), "sequence", "tensor"), logits.shape, mesh)
     return jax.lax.with_sharding_constraint(logits, NamedSharding(mesh, spec))
 
 
@@ -175,6 +175,31 @@ class MPTBlock(nn.Module):
         # --- MLP ---
         h = _norm(cfg, "ln_2")(x)
         hidden = cfg.mlp_hidden_size or cfg.expansion_ratio * cfg.d_model
+        if cfg.mlp == "moe":
+            # expert-parallel MLP (ops/moe.py): router + E expert FFNs,
+            # GShard dense dispatch. Expert weights carry a leading [E]
+            # axis sharded over the `expert` mesh axis
+            # (parallel/sharding.py); the Switch aux loss is sown and
+            # collected by make_loss_fn when `intermediates` is mutable
+            # (inference apply() leaves it immutable -> sow is a no-op).
+            from photon_tpu.ops.moe import moe_mlp
+
+            pd = _dtype(cfg.param_dtype)
+            init = nn.initializers.normal(stddev=cfg.emb_init_std)
+            router_w = self.param(
+                "router", init, (cfg.d_model, cfg.moe_num_experts), pd)
+            w_up = self.param(
+                "moe_up", init, (cfg.moe_num_experts, cfg.d_model, hidden), pd)
+            w_down = self.param(
+                "moe_down",
+                nn.initializers.normal(stddev=resid_std),
+                (cfg.moe_num_experts, hidden, cfg.d_model), pd)
+            moe_out, aux = moe_mlp(
+                h.astype(compute), router_w, w_up, w_down,
+                top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+            )
+            self.sow("intermediates", "moe_aux", aux)
+            return x + moe_out
         if cfg.mlp == "swiglu":
             # separate gate/up projections (standard llama layout): each is
             # column-parallel under the same sharding rule, so silu(gate)*up
@@ -247,7 +272,9 @@ class MPTModel(nn.Module):
         # stack layers: params get a leading [n_layers] axis; single trace
         stack = nn.scan(
             block_cls,
-            variable_axes={"params": 0},
+            # intermediates: per-layer MoE aux losses stack to [n_layers]
+            # (empty when nothing is sown / the collection is immutable)
+            variable_axes={"params": 0, "intermediates": 0},
             split_rngs={"params": True},
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},

@@ -1,0 +1,105 @@
+"""Mixture-of-Experts routing: GShard/Switch-style dense dispatch with a
+static capacity — the TPU-native MoE formulation (einsums over one-hot
+dispatch/combine tensors; every shape static, so XLA tiles the expert
+matmuls onto the MXU and inserts the expert-axis all_to_alls itself).
+
+The reference has no MoE anywhere (its models are dense MPT/llama
+variants); expert parallelism is part of this framework's
+beyond-the-reference scale-out surface, alongside ring attention
+(sequence) and the pipeline schedule (pipe).
+
+Design notes:
+- **Dense dispatch, not gather/scatter**: token→expert routing is encoded
+  as a ``[N, E, C]`` one-hot dispatch tensor and contracted with einsums.
+  O(N·E·C) memory, but static shapes and pure matmuls — the standard TPU
+  trade (mesh-tensorflow / GShard / Switch lineage) against the GPU-style
+  dynamic gather which XLA cannot tile.
+- **Static capacity**: each expert processes at most
+  ``C = ceil(k·N/E · capacity_factor)`` tokens; overflow tokens fall
+  through the residual connection (their combine weights are zero).
+  Slot-0 (highest-gate) assignments claim capacity before slot-1, so
+  top-1 routing degrades gracefully under overflow.
+- **Switch aux loss** (load balance): ``E · Σ_e f_e · P_e`` where ``f_e``
+  is the fraction of tokens whose top-1 choice is ``e`` and ``P_e`` the
+  mean router probability — differentiable through ``P_e`` only, pushing
+  probability mass toward underloaded experts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(n_tokens: int, n_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Static per-expert slot count (≥1)."""
+    return max(1, int(-(-top_k * n_tokens * capacity_factor // n_experts)))
+
+
+def route(probs: jax.Array, top_k: int, capacity: int):
+    """Build dispatch/combine tensors from router probabilities.
+
+    Args:
+      probs: ``[N, E]`` softmax router probabilities (fp32).
+      top_k: experts per token.
+      capacity: static per-expert slot count.
+
+    Returns:
+      ``(dispatch, combine, aux)`` where ``dispatch`` is ``[N, E, C]``
+      {0,1}, ``combine`` is ``[N, E, C]`` gate weights (renormalized over
+      the token's kept experts), and ``aux`` is the Switch load-balance
+      loss for this routing decision.
+    """
+    n, e = probs.shape
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)  # [N, k]
+    # one-hot expert choice per slot: [k, N, E]
+    oh = jax.nn.one_hot(jnp.swapaxes(gate_idx, 0, 1), e, dtype=probs.dtype)
+    # positions within each expert's buffer, slot-major (slot 0 first):
+    # cumsum over the flattened (k·N) assignment order
+    flat = oh.reshape(top_k * n, e)
+    pos = (jnp.cumsum(flat, axis=0) - flat).reshape(top_k, n, e)
+    keep = oh * (pos < capacity)
+    # gates renormalized over KEPT slots only (a dropped expert's weight
+    # is redistributed; fully-dropped tokens pass through the residual)
+    kept_gate = gate_vals * jnp.swapaxes(keep.sum(-1), 0, 1)  # [N, k]
+    denom = jnp.maximum(kept_gate.sum(-1, keepdims=True), 1e-9)
+    gates = kept_gate / denom
+    # dispatch[n,e,c] = Σ_k keep[k,n,e] · 1[pos[k,n,e] == c]
+    pos_oh = jax.nn.one_hot(pos, capacity, dtype=probs.dtype)  # [k,N,E,C]
+    dispatch = jnp.einsum("kne,knec->nec", keep, pos_oh)
+    combine = jnp.einsum("kn,kne,knec->nec",
+                         jnp.swapaxes(gates, 0, 1), keep, pos_oh)
+    # Switch aux loss on the top-1 choice
+    top1 = oh[0]  # [N, E]
+    f = jnp.mean(top1, axis=0)          # fraction routed (not differentiable)
+    p = jnp.mean(probs, axis=0)          # mean router prob (differentiable)
+    aux = e * jnp.sum(f * p)
+    return dispatch, combine, aux
+
+
+def moe_mlp(x: jax.Array, router_w: jax.Array, w_up: jax.Array,
+            w_down: jax.Array, *, top_k: int, capacity_factor: float,
+            act=jax.nn.gelu):
+    """Expert-parallel MLP over ``[B, S, D]`` activations.
+
+    ``router_w``: ``[D, E]``; ``w_up``: ``[E, D, H]``; ``w_down``:
+    ``[E, H, D]`` — shard the leading ``E`` over the ``expert`` mesh axis
+    and XLA turns the dispatch/return einsums into all_to_alls over ICI.
+    Returns ``(out [B,S,D], aux_loss scalar)``.
+    """
+    b, s, d = x.shape
+    n = b * s
+    e = router_w.shape[-1]
+    xf = x.reshape(n, d)
+    logits = jnp.asarray(xf, jnp.float32) @ jnp.asarray(router_w, jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    cap = expert_capacity(n, e, top_k, capacity_factor)
+    dispatch, combine, aux = route(probs, top_k, cap)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf)
+    h = act(jnp.einsum("ecd,edh->ech", expert_in, w_up.astype(x.dtype)))
+    expert_out = jnp.einsum("ech,ehd->ecd", h, w_down.astype(x.dtype))
+    out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+    return out.reshape(b, s, d), aux

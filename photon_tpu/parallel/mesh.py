@@ -13,6 +13,8 @@ Axes (SURVEY.md §2.3 mapping):
 - ``sequence`` — context parallel (no reference analog; ring attention)
 - ``pipe``     — pipeline parallel (no reference analog; GPipe-style stage
   schedule over ``ppermute`` — ``parallel/pipeline.py``)
+- ``expert``   — expert parallel (no reference analog; MoE dispatch over
+  all_to_all — ``ops/moe.py``)
 """
 
 from __future__ import annotations
@@ -23,7 +25,7 @@ from jax.sharding import Mesh
 
 from photon_tpu.config.schema import MeshConfig
 
-AXES = ("data", "fsdp", "tensor", "sequence", "pipe")
+AXES = ("data", "fsdp", "tensor", "sequence", "pipe", "expert")
 
 
 def make_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
@@ -31,11 +33,11 @@ def make_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
     if cfg.size > len(devices):
         raise ValueError(f"mesh needs {cfg.size} devices, have {len(devices)}")
     devs = np.asarray(devices[: cfg.size]).reshape(
-        cfg.data, cfg.fsdp, cfg.tensor, cfg.sequence, cfg.pipe
+        cfg.data, cfg.fsdp, cfg.tensor, cfg.sequence, cfg.pipe, cfg.expert
     )
     return Mesh(devs, AXES)
 
 
 def single_device_mesh(device=None) -> Mesh:
     device = device or jax.devices()[0]
-    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1, 1), AXES)
+    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1, 1, 1), AXES)

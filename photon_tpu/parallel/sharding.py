@@ -36,6 +36,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _RULES: list[tuple[str, P]] = [
     (r"wte/embedding$", P("fsdp", "tensor")),
     (r"^wpe$", P(None, "fsdp")),
+    # MoE (ops/moe.py): experts shard over `expert`; inner dims follow the
+    # dense column/row-parallel convention
+    (r"router$", P("pipe", "fsdp", None)),
+    (r"moe_up$", P("pipe", "expert", "fsdp", "tensor")),
+    (r"moe_down$", P("pipe", "expert", "tensor", "fsdp")),
     (r"(wqkv|up_proj|gate_proj|q_proj|k_proj|v_proj)/kernel$", P("pipe", "fsdp", "tensor")),
     (r"(out_proj|down_proj)/kernel$", P("pipe", "tensor", "fsdp")),
     (r"(wqkv|up_proj|gate_proj|q_proj|k_proj|v_proj)/bias$", P("pipe", "tensor")),
@@ -86,9 +91,14 @@ def shard_params(params: Any, mesh: Mesh) -> Any:
 
 
 def batch_spec(mesh: Mesh) -> P:
-    """Tokens [B, S]: batch over data+fsdp, sequence over sequence axis."""
+    """Tokens [B, S]: batch over data+fsdp+expert, sequence over the
+    sequence axis. ``expert`` joins the batch axes (the standard GShard
+    layout): tokens split over expert chips too, so the MoE dispatch
+    lowers to all_to_alls and the dense layers get real data parallelism
+    from the expert axis instead of replicated compute. A no-op on
+    expert=1 meshes."""
     del mesh
-    return P(("data", "fsdp"), "sequence")
+    return P(("data", "fsdp", "expert"), "sequence")
 
 
 def state_shardings(state: Any, mesh: Mesh) -> Any:

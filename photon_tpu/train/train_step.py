@@ -79,22 +79,46 @@ def _chunked_ce_sum(
     return total
 
 
+def _apply_collecting_aux(model: MPTModel, params, tokens, **kwargs):
+    """``model.apply`` that also returns the summed MoE aux loss (0.0 for
+    dense models). The MoE blocks sow per-layer Switch load-balance terms
+    into ``intermediates`` (``models/mpt.py``); plain inference applies
+    leave the collection immutable, so sow is a no-op there."""
+    if model.cfg.mlp != "moe":
+        return model.apply({"params": params}, tokens, **kwargs), jnp.zeros([], jnp.float32)
+    out, variables = model.apply(
+        {"params": params}, tokens, mutable=["intermediates"], **kwargs
+    )
+    # fold ONLY the moe_aux entries into the objective — any other sown
+    # diagnostic (e.g. router stats for logging) must not leak into loss
+    aux = jnp.zeros([], jnp.float32)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+        variables.get("intermediates", {})
+    ):
+        if any(getattr(k, "key", None) == "moe_aux" for k in path):
+            aux = aux + jnp.sum(jnp.asarray(leaf, jnp.float32))
+    return out, model.cfg.moe_aux_weight * aux
+
+
 def make_loss_fn(model: MPTModel, loss_chunk_tokens: int = 2048) -> Callable:
     def loss_fn(params, tokens: jax.Array):
-        """Mean next-token cross entropy over ``[B, S] int32`` tokens."""
+        """Mean next-token cross entropy over ``[B, S] int32`` tokens
+        (+ the weighted MoE load-balance aux loss when mlp='moe')."""
         if loss_chunk_tokens:
-            hidden = model.apply({"params": params}, tokens, return_hidden=True)
+            hidden, aux = _apply_collecting_aux(
+                model, params, tokens, return_hidden=True
+            )
             ce_sum = _chunked_ce_sum(
                 model, params, hidden[:, :-1], tokens[:, 1:], loss_chunk_tokens
             )
-            return ce_sum / (tokens.shape[0] * (tokens.shape[1] - 1))
-        logits = model.apply({"params": params}, tokens)
+            return ce_sum / (tokens.shape[0] * (tokens.shape[1] - 1)) + aux
+        logits, aux = _apply_collecting_aux(model, params, tokens)
         targets = tokens[:, 1:]
         logits = logits[:, :-1]
         ce = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), targets
         )
-        return jnp.mean(ce)
+        return jnp.mean(ce) + aux
 
     return loss_fn
 

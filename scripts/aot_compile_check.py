@@ -146,7 +146,8 @@ def main() -> int:
     from photon_tpu.parallel.mesh import make_mesh
     from photon_tpu.parallel.sharding import batch_spec, state_shardings
 
-    axes = {"data": 1, "fsdp": 1, "tensor": 1, "sequence": 1, "pipe": 1}
+    axes = {"data": 1, "fsdp": 1, "tensor": 1, "sequence": 1, "pipe": 1,
+            "expert": 1}
     if args.mesh:
         for kv in args.mesh.split(","):
             k, _, v = kv.partition("=")

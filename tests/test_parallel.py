@@ -33,7 +33,7 @@ def _cfg(mesh: MeshConfig) -> Config:
 def test_mesh_axes_and_size():
     mesh = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2, sequence=1))
     assert mesh.shape == {"data": 2, "fsdp": 2, "tensor": 2, "sequence": 1,
-                          "pipe": 1}
+                          "pipe": 1, "expert": 1}
     with pytest.raises(ValueError):
         make_mesh(MeshConfig(data=16))
 
