@@ -96,8 +96,22 @@ def _qkv(lp: dict, h: jax.Array, cfg: ModelConfig):
             v.reshape(*lead, n_kv, cfg.d_head))
 
 
-def _mlp(lp: dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+def _mlp(lp: dict, x: jax.Array, cfg: ModelConfig,
+         token_mask: jax.Array | None = None) -> jax.Array:
     h = _norm(x, lp["ln_2"]["scale"], lp["ln_2"].get("bias"), cfg.norm, cfg.norm_eps)
+    if cfg.mlp == "moe":
+        # same routing as training (ops/moe.py); aux loss discarded.
+        # token_mask (prefill): right-padding must not claim expert
+        # capacity — otherwise a row's logits would depend on how much
+        # padding its batch-mates carry
+        from photon_tpu.ops.moe import moe_mlp
+
+        out, _ = moe_mlp(
+            h, lp["router"], lp["moe_up"], lp["moe_down"],
+            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+            token_mask=token_mask,
+        )
+        return x + out
     if cfg.mlp == "swiglu":
         h = jax.nn.silu(_dense(lp, "gate_proj", h)) * _dense(lp, "up_proj", h)
     else:
@@ -134,6 +148,7 @@ def prefill(params: dict, tokens: jax.Array, lengths: jax.Array,
     b, s = tokens.shape
     n_kv = cfg.n_kv_heads or cfg.n_heads
     pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    valid = (pos < lengths[:, None]).astype(jnp.float32)  # [B, S] real tokens
     x = _embed(params, tokens, pos, cfg)
 
     def layer(x, lp):
@@ -154,7 +169,7 @@ def prefill(params: dict, tokens: jax.Array, lengths: jax.Array,
             block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
         )
         x = x + _dense(lp, "out_proj", attn.reshape(b, s, cfg.d_model))
-        return _mlp(lp, x, cfg), (k, v)
+        return _mlp(lp, x, cfg, token_mask=valid), (k, v)
 
     x, (ck, cv) = jax.lax.scan(layer, x, params["blocks"]["block"])
     idx = jnp.clip(lengths - 1, 0, s - 1)

@@ -146,7 +146,7 @@ def multihead_attention(
             from photon_tpu.parallel.context import current_mesh
 
             mesh = current_mesh()
-            sharded_axes = [a for a in ("data", "fsdp", "tensor")
+            sharded_axes = [a for a in ("data", "fsdp", "expert", "tensor")
                             if mesh is not None and mesh.shape.get(a, 1) > 1]
             if not sharded_axes:
                 return flash_attention(q, k, v, causal=causal, alibi=alibi,
@@ -175,7 +175,7 @@ def multihead_attention(
                                        block_q=bq, block_k=bk,
                                        interpret=interpret)
 
-            spec = P(("data", "fsdp"), None, "tensor", None)
+            spec = P(("data", "fsdp", "expert"), None, "tensor", None)
             fn = shard_map(
                 _local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                 # pallas_call emits un-annotated out-avals; varying-axis

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def expert_capacity(n_tokens: int, n_experts: int, top_k: int,
@@ -37,13 +38,18 @@ def expert_capacity(n_tokens: int, n_experts: int, top_k: int,
     return max(1, int(-(-top_k * n_tokens * capacity_factor // n_experts)))
 
 
-def route(probs: jax.Array, top_k: int, capacity: int):
+def route(probs: jax.Array, top_k: int, capacity: int,
+          token_mask: jax.Array | None = None):
     """Build dispatch/combine tensors from router probabilities.
 
     Args:
       probs: ``[N, E]`` softmax router probabilities (fp32).
       top_k: experts per token.
       capacity: static per-expert slot count.
+      token_mask: optional ``[N]`` {0,1} validity mask — masked (padding)
+        tokens claim NO capacity slots and are excluded from the aux-loss
+        statistics (prefill over right-padded prompts would otherwise let
+        padding displace real tokens from expert buffers).
 
     Returns:
       ``(dispatch, combine, aux)`` where ``dispatch`` is ``[N, E, C]``
@@ -55,6 +61,8 @@ def route(probs: jax.Array, top_k: int, capacity: int):
     gate_vals, gate_idx = jax.lax.top_k(probs, top_k)  # [N, k]
     # one-hot expert choice per slot: [k, N, E]
     oh = jax.nn.one_hot(jnp.swapaxes(gate_idx, 0, 1), e, dtype=probs.dtype)
+    if token_mask is not None:
+        oh = oh * token_mask.astype(probs.dtype)[None, :, None]
     # positions within each expert's buffer, slot-major (slot 0 first):
     # cumsum over the flattened (k·N) assignment order
     flat = oh.reshape(top_k * n, e)
@@ -70,36 +78,46 @@ def route(probs: jax.Array, top_k: int, capacity: int):
     dispatch = jnp.einsum("kne,knec->nec", keep, pos_oh)
     combine = jnp.einsum("kn,kne,knec->nec",
                          jnp.swapaxes(gates, 0, 1), keep, pos_oh)
-    # Switch aux loss on the top-1 choice
-    top1 = oh[0]  # [N, E]
-    f = jnp.mean(top1, axis=0)          # fraction routed (not differentiable)
-    p = jnp.mean(probs, axis=0)          # mean router prob (differentiable)
+    # Switch aux loss on the top-1 choice (over VALID tokens only)
+    top1 = oh[0]  # [N, E] (already zeroed for masked tokens)
+    if token_mask is None:
+        n_valid = jnp.asarray(n, probs.dtype)
+        p_sum = jnp.sum(probs, axis=0)
+    else:
+        m = token_mask.astype(probs.dtype)
+        n_valid = jnp.maximum(jnp.sum(m), 1.0)
+        p_sum = jnp.sum(probs * m[:, None], axis=0)
+    f = jnp.sum(top1, axis=0) / n_valid  # fraction routed (not differentiable)
+    p = p_sum / n_valid                  # mean router prob (differentiable)
     aux = e * jnp.sum(f * p)
     return dispatch, combine, aux
 
 
 def moe_mlp(x: jax.Array, router_w: jax.Array, w_up: jax.Array,
             w_down: jax.Array, *, top_k: int, capacity_factor: float,
-            act=jax.nn.gelu):
+            act=jax.nn.gelu, token_mask: jax.Array | None = None):
     """Expert-parallel MLP over ``[B, S, D]`` activations.
 
     ``router_w``: ``[D, E]``; ``w_up``: ``[E, D, H]``; ``w_down``:
     ``[E, H, D]`` — shard the leading ``E`` over the ``expert`` mesh axis
     and XLA turns the dispatch/return einsums into all_to_alls over ICI.
-    Returns ``(out [B,S,D], aux_loss scalar)``.
+    Returns ``(out [..., D], aux_loss scalar)``. Any number of leading
+    dims (the KV-cache decode path routes single-token ``[B, D]`` steps
+    through the same function).
     """
-    b, s, d = x.shape
-    n = b * s
+    lead, d = x.shape[:-1], x.shape[-1]
+    n = int(np.prod(lead))
     e = router_w.shape[-1]
     xf = x.reshape(n, d)
     logits = jnp.asarray(xf, jnp.float32) @ jnp.asarray(router_w, jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
     cap = expert_capacity(n, e, top_k, capacity_factor)
-    dispatch, combine, aux = route(probs, top_k, cap)
+    mask_flat = None if token_mask is None else token_mask.reshape(n)
+    dispatch, combine, aux = route(probs, top_k, cap, token_mask=mask_flat)
     dispatch = dispatch.astype(x.dtype)
     combine = combine.astype(x.dtype)
     expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf)
     h = act(jnp.einsum("ecd,edh->ech", expert_in, w_up.astype(x.dtype)))
     expert_out = jnp.einsum("ech,ehd->ecd", h, w_down.astype(x.dtype))
     out = jnp.einsum("nec,ecd->nd", combine, expert_out)
-    return out.reshape(b, s, d), aux
+    return out.reshape(*lead, d), aux

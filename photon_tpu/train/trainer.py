@@ -77,7 +77,11 @@ class Trainer:
         # ``device_train_microbatch_size``); a scan step processes
         # micro × dp_degree global rows, where dp_degree covers the batch-
         # sharded mesh axes (data and fsdp)
-        dp_degree = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        # batch rows shard over data+fsdp+expert (parallel/sharding.py
+        # batch_spec): every axis that splits the batch counts toward the
+        # per-device row accounting
+        dp_degree = (self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+                     * self.mesh.shape.get("expert", 1))
         # batch/device-count adaptation (reference:
         # ``photon/clients/llm_config_functions.py:865-900`` rounds the batch
         # to the visible device count, with a warning): a global batch not

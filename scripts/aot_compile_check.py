@@ -175,7 +175,7 @@ def main() -> int:
     # trainer semantics (trainer.py rows_per_scan): each scan step consumes
     # micro rows PER data-parallel shard. Eval has no microbatch scan — it
     # only needs the batch to split over the data-parallel shards.
-    dp_degree = axes["data"] * axes["fsdp"]
+    dp_degree = axes["data"] * axes["fsdp"] * axes["expert"]
     rows_per_scan = args.micro * dp_degree if args.program == "train" else dp_degree
     if args.gbs % rows_per_scan:
         raise SystemExit(f"gbs {args.gbs} not divisible by "

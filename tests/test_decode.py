@@ -31,11 +31,23 @@ def _mpt_cfg(alibi: bool) -> Config:
     return cfg.validate()
 
 
+def _moe_cfg():
+    cfg = _mpt_cfg(alibi=False)
+    cfg.model.mlp = "moe"
+    cfg.model.moe_num_experts = 4
+    cfg.model.moe_top_k = 2
+    # ample capacity: decode's per-token batches are tiny, and the
+    # prefill-vs-decode parity assertion needs identical (drop-free) routing
+    cfg.model.moe_capacity_factor = 4.0
+    return cfg.validate()
+
+
 def _configs():
     return [
         ("mpt-wpe", _mpt_cfg(alibi=False)),
         ("mpt-alibi", _mpt_cfg(alibi=True)),
         ("llama-gqa", tiny_llama_config(n_kv_heads=2)),
+        ("mpt-moe", _moe_cfg()),
     ]
 
 

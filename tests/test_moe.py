@@ -159,6 +159,32 @@ def test_moe_aux_loss_reaches_training_loss():
     assert with_aux > without
 
 
+def test_moe_prefill_padding_claims_no_capacity():
+    """Right-padding must not displace real tokens from expert buffers:
+    at TIGHT capacity, a row's prefill logits are identical whether the
+    batch carries 3 or 11 padding columns (`route(token_mask=...)`)."""
+    from photon_tpu.models.decode import prefill
+
+    cfg = _moe_cfg(MeshConfig())
+    cfg.model.moe_capacity_factor = 1.0  # tight: pad tokens would displace
+    cfg.validate()
+    from photon_tpu.models.mpt import init_params as ip
+
+    params = ip(cfg.model, seed=0)
+    rng = np.random.default_rng(0)
+    rows = rng.integers(1, 64, (2, 5)).astype(np.int32)
+    lengths = jnp.asarray([5, 3])
+
+    def run(pad_to):
+        toks = np.zeros((2, pad_to), np.int32)
+        toks[:, :5] = rows
+        toks[1, 3:] = 0
+        logits, _ = prefill(params, jnp.asarray(toks), lengths, cfg.model)
+        return np.asarray(logits)
+
+    np.testing.assert_allclose(run(8), run(16), atol=1e-5)
+
+
 def test_moe_trains_and_capacity_is_static():
     from photon_tpu.train.train_step import make_train_step
 
