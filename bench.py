@@ -65,6 +65,9 @@ PHOTON_BENCH_SECOND_MICRO (pinned-config second microbatch trial after the
 first emit; default 2x the pinned micro, 0 disables),
 PHOTON_BENCH_TRY_BLOCK (flash tile trial after the micro trials; default
 512, or 0 — disabled — when PHOTON_BENCH_FLASH_BLOCK pins a measured tile),
+PHOTON_BENCH_FLASH_BLOCK_K (pin an asymmetric k tile),
+PHOTON_BENCH_TRY_BLOCK_QK (asymmetric "q,k" tile trial after the chunk
+trial; default "2048,1024", 0 disables),
 PHOTON_BENCH_SKIP_SWEEP=1 (skip the microbatch sweep),
 PHOTON_BENCH_PROFILE=1 (write a jax.profiler trace of the timed window),
 PHOTON_BENCH_ATTN (force attn_impl: xla|pallas — the safe rung uses xla),
@@ -161,6 +164,8 @@ def _tuned_env() -> dict:
         env["PHOTON_BENCH_REMAT"] = "1"
     if cfg.get("flash_block"):
         env["PHOTON_BENCH_FLASH_BLOCK"] = str(cfg["flash_block"])
+    if cfg.get("flash_block_k"):
+        env["PHOTON_BENCH_FLASH_BLOCK_K"] = str(cfg["flash_block_k"])
     if cfg.get("loss_chunk"):
         env["PHOTON_BENCH_CHUNK"] = str(cfg["loss_chunk"])
     return env
@@ -429,6 +434,9 @@ def supervise() -> int:
             if result.get("loss_chunk_tokens"):
                 env.setdefault("PHOTON_BENCH_CHUNK",
                                str(result["loss_chunk_tokens"]))
+            if result.get("flash_block_k"):
+                env.setdefault("PHOTON_BENCH_FLASH_BLOCK_K",
+                               str(result["flash_block_k"]))
             cmd = [sys.executable, str(pathlib.Path(__file__).resolve()),
                    "--stage", stage, "--platform", "tpu"]
             log(f"stage {stage}: spawning (hard {tmo}s)")
@@ -833,6 +841,9 @@ def tpu_convergence_slice(dev) -> dict | None:
         if blk:
             cfg.model.flash_block_q = blk
             cfg.model.flash_block_k = blk
+        blk_k = int(os.environ.get("PHOTON_BENCH_FLASH_BLOCK_K", "0"))
+        if blk_k:
+            cfg.model.flash_block_k = blk_k
         # run at the winning rung's CE chunk too (the supervisor forwards
         # the banked result's config into stage children)
         chunk_env = os.environ.get("PHOTON_BENCH_CHUNK", "")
@@ -1245,6 +1256,9 @@ def run(platform: str) -> None:
     if tuned_block:
         cfg.model.flash_block_q = tuned_block
         cfg.model.flash_block_k = tuned_block
+    tuned_block_k = int(os.environ.get("PHOTON_BENCH_FLASH_BLOCK_K", "0"))
+    if tuned_block_k:
+        cfg.model.flash_block_k = tuned_block_k
     if not on_tpu:  # smoke-scale fallback so the bench also runs on CPU
         cfg.model.n_layers = 2
         cfg.model.max_seq_len = 256
@@ -1357,6 +1371,7 @@ def run(platform: str) -> None:
         "global_batch": gbs,
         "remat": cfg.model.remat,
         "flash_block": cfg.model.flash_block_q,
+        "flash_block_k": cfg.model.flash_block_k,
         "loss_chunk_tokens": cfg.train.loss_chunk_tokens,
         "final_loss": round(loss, 3),
         "jax_version": jax.__version__,
@@ -1423,7 +1438,7 @@ def run(platform: str) -> None:
             c.model.flash_block_k = b
 
         upgrade_trial(f"block-{block} trial", micro, _blocks,
-                      {"flash_block": block})
+                      {"flash_block": block, "flash_block_k": block})
 
     # CE-chunk trial: the loss path was the #1 HBM sink pre-chunking;
     # bigger chunks mean fewer, larger lm-head matmuls (4096/8192
@@ -1433,15 +1448,39 @@ def run(platform: str) -> None:
                                "0" if pin_chunk else "4096"))
     if on_tpu and chunk and cfg.train.loss_chunk_tokens \
             and chunk != cfg.train.loss_chunk_tokens:
-        def _chunk(c, n=chunk, b=out["flash_block"]):
+        def _chunk(c, n=chunk, bq=out["flash_block"], bk=out["flash_block_k"]):
             c.train.loss_chunk_tokens = n
-            # carry the winning flash tile into the candidate config (the
-            # block trial mutates only its own copy, never `cfg`)
-            c.model.flash_block_q = b
-            c.model.flash_block_k = b
+            # carry the winning flash tile (possibly asymmetric) into the
+            # candidate config (trials mutate only their own copies)
+            c.model.flash_block_q = bq
+            c.model.flash_block_k = bk
 
         upgrade_trial(f"chunk-{chunk} trial", micro, _chunk,
                       {"loss_chunk_tokens": chunk})
+
+    # Asymmetric tile trial: q2048 x k1024 compiles (square 2048 is
+    # scoped-vmem-rejected) and halves the outer grid — AOT-verified at
+    # 8.47 GiB like the square tiles. Runs at the winning chunk/tile
+    # config; 0 or "" disables.
+    # default off when an asymmetric k pin exists (a measured winner or
+    # loser is already encoded in bench_tuned.json, like TRY_BLOCK/TRY_CHUNK)
+    qk = os.environ.get("PHOTON_BENCH_TRY_BLOCK_QK",
+                        "0" if tuned_block_k else "2048,1024")
+    if on_tpu and qk and qk != "0" and cfg.model.attn_impl == "pallas":
+        try:
+            bq_t, bk_t = (int(v) for v in qk.split(","))
+        except ValueError:
+            log(f"PHOTON_BENCH_TRY_BLOCK_QK={qk!r} malformed (want 'q,k'); "
+                "skipping the asymmetric tile trial")
+            bq_t = bk_t = 0
+        cur = (out["flash_block"], out.get("flash_block_k"))
+        if bq_t and bk_t and (bq_t, bk_t) != cur:
+            def _qk(c, bq=bq_t, bk=bk_t, n=out["loss_chunk_tokens"]):
+                c.model.flash_block_q = bq
+                c.model.flash_block_k = bk
+                c.train.loss_chunk_tokens = n  # carry the chunk-trial win
+            upgrade_trial(f"block-qk-{bq_t}x{bk_t} trial", micro, _qk,
+                          {"flash_block": bq_t, "flash_block_k": bk_t})
 
     # under the supervisor (PHOTON_BENCH_ORCHESTRATED) parity and the
     # evidence stages run in their own child processes with fresh relay

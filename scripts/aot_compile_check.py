@@ -64,6 +64,8 @@ def main() -> int:
     ap.add_argument("--gbs", type=int, default=256)
     ap.add_argument("--impl", default="pallas", choices=["pallas", "xla"])
     ap.add_argument("--block", type=int, default=0, help="flash tile (q=k)")
+    ap.add_argument("--block-k", type=int, default=0,
+                    help="flash k tile (asymmetric; overrides --block for k)")
     ap.add_argument("--chunk", type=int, default=2048, help="loss chunk tokens (0 = off)")
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--layers", type=int, default=0, help="override n_layers")
@@ -112,6 +114,8 @@ def main() -> int:
     if args.block:
         cfg.model.flash_block_q = args.block
         cfg.model.flash_block_k = args.block
+    if args.block_k:
+        cfg.model.flash_block_k = args.block_k
     if args.layers:
         cfg.model.n_layers = args.layers
     if args.seq:
@@ -224,6 +228,7 @@ def main() -> int:
         "n_devices": len(topo.devices),
         "impl": args.impl,
         "block": args.block or cfg.model.flash_block_q,
+        "block_k": cfg.model.flash_block_k,
         "chunk": args.chunk,
         "micro": args.micro,
         "gbs": args.gbs,
