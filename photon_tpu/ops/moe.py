@@ -67,6 +67,7 @@ def route(probs: jax.Array, top_k: int, capacity: int,
     # cumsum over the flattened (k·N) assignment order
     flat = oh.reshape(top_k * n, e)
     pos = (jnp.cumsum(flat, axis=0) - flat).reshape(top_k, n, e)
+    pos = pos.astype(jnp.int32)  # one_hot wants integer positions
     keep = oh * (pos < capacity)
     # gates renormalized over KEPT slots only (a dropped expert's weight
     # is redistributed; fully-dropped tokens pass through the residual)

@@ -172,7 +172,15 @@ def make_pipeline_train_step(
                 buf, ce_sum = carry
                 t, tok_in, tok_out = xs
                 # stage 0 feeds microbatch t (bubble ticks feed a dead
-                # microbatch whose loss contribution is masked out below)
+                # microbatch whose loss contribution is masked out below).
+                # Known inefficiency, kept deliberately: every stage
+                # computes the embed and the CE tail and masks the result
+                # — (P-1)/P of that compute is wasted. Replacing the
+                # where-masks with lax.cond (which WOULD skip the dead
+                # branches: the predicates are uniform per device) crashes
+                # XLA's SPMD partitioner inside the partial-manual region,
+                # the same CHECK-abort family the embed sharding
+                # constraint works around (_batch_constrain).
                 x = jnp.where(idx == 0, _embed(cfg, others, tok_in, mesh), buf)
                 y = _stage_apply(cfg, blocks["block"], x)
                 # last stage: microbatch t-(P-1) exits the pipe this tick
