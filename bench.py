@@ -424,19 +424,32 @@ def supervise() -> int:
                 continue
             env = dict(os.environ)
             env["PHOTON_BENCH_CHILD_DEADLINE"] = str(time.time() + tmo - 60)
-            # run every stage at the winning rung's configuration
-            if result.get("flash_block"):
-                env.setdefault("PHOTON_BENCH_FLASH_BLOCK",
-                               str(result["flash_block"]))
+            # run every stage at the winning rung's configuration — except
+            # q tiles > 1024: the TRAIN step compiles at q2048 but the
+            # forward-only programs stages also run (eval pass, gauntlet
+            # prefill/decode) are scoped-vmem-rejected there (17.9M > 16M,
+            # AOT-verified); stages cap at the verified 1024 tile. The cap
+            # must also override an operator env pin (setdefault would let
+            # an exported FLASH_BLOCK=2048 crash every stage), and the
+            # divergence is recorded: parity then attests the STAGE tile,
+            # not the headline tile.
+            fb = int(env.get("PHOTON_BENCH_FLASH_BLOCK")
+                     or result.get("flash_block") or 0)
+            fbk = int(env.get("PHOTON_BENCH_FLASH_BLOCK_K")
+                      or result.get("flash_block_k") or 0)
+            if fb > 1024:
+                fb, fbk = 1024, min(fbk or 1024, 1024)
+                result["stages_flash_block"] = fb
+            if fb:
+                env["PHOTON_BENCH_FLASH_BLOCK"] = str(fb)
+            if fbk:
+                env["PHOTON_BENCH_FLASH_BLOCK_K"] = str(fbk)
             if result.get("microbatch"):
                 env.setdefault("PHOTON_BENCH_MICROBATCH",
                                str(result["microbatch"]))
             if result.get("loss_chunk_tokens"):
                 env.setdefault("PHOTON_BENCH_CHUNK",
                                str(result["loss_chunk_tokens"]))
-            if result.get("flash_block_k"):
-                env.setdefault("PHOTON_BENCH_FLASH_BLOCK_K",
-                               str(result["flash_block_k"]))
             cmd = [sys.executable, str(pathlib.Path(__file__).resolve()),
                    "--stage", stage, "--platform", "tpu"]
             log(f"stage {stage}: spawning (hard {tmo}s)")

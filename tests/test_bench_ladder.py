@@ -33,7 +33,8 @@ def bench(monkeypatch, tmp_path):
                 "PHOTON_BENCH_SKIP_STAGES", "PHOTON_BENCH_CONV",
                 "PHOTON_BENCH_GAUNTLET", "PHOTON_BENCH_1B",
                 "PHOTON_BENCH_SAVE_SLICE_PARAMS", "PHOTON_BENCH_STAGE_BUDGET",
-                "PHOTON_BENCH_CHUNK", "PHOTON_BENCH_TRY_CHUNK"):
+                "PHOTON_BENCH_CHUNK", "PHOTON_BENCH_TRY_CHUNK",
+                "PHOTON_BENCH_FLASH_BLOCK_K", "PHOTON_BENCH_TRY_BLOCK_QK"):
         monkeypatch.delenv(var, raising=False)
     return mod
 
@@ -236,6 +237,41 @@ def test_conv_without_saved_params_drops_gauntlet_stage(bench, scripted):
     stage_cmds = [b["cmd"] for b in built[2:]]
     assert [c[c.index("--stage") + 1] for c in stage_cmds] == [
         "parity", "conv", "1b"]
+
+
+def test_stage_children_cap_flash_tile_at_1024(bench, scripted):
+    # a q2048 headline win must not reach the stage children: the
+    # forward-only programs they run (eval pass, gauntlet prefill/decode)
+    # are scoped-vmem-rejected above q1024
+    final, built = scripted([
+        {"stdout": _result_line(bench, 65000.0, platform="tpu"),
+         "stderr": "backend up\ncompile+step in 30s"},
+        {"stdout": _result_line(bench, 90000.0, platform="tpu",
+                                flash_block=2048, flash_block_k=1024),
+         "stderr": "backend up\ncompile+step in 31s"},
+        *_stage_children(),
+    ])
+    assert final["flash_block"] == 2048  # headline keeps the real winner
+    assert built[2]["env"]["PHOTON_BENCH_FLASH_BLOCK"] == "1024"
+    assert built[2]["env"]["PHOTON_BENCH_FLASH_BLOCK_K"] == "1024"
+    # the divergence is recorded: parity attests the stage tile, not q2048
+    assert final["stages_flash_block"] == 1024
+
+
+def test_stage_tile_cap_overrides_operator_env_pin(bench, scripted, monkeypatch):
+    # an exported FLASH_BLOCK=2048 must not ride into stage children via
+    # dict(os.environ) — setdefault would be a no-op and every stage would
+    # hit the scoped-vmem rejection
+    monkeypatch.setenv("PHOTON_BENCH_FLASH_BLOCK", "2048")
+    final, built = scripted([
+        {"stdout": _result_line(bench, 65000.0, platform="tpu"),
+         "stderr": "backend up\ncompile+step in 30s"},
+        {"stdout": _result_line(bench, 90000.0, platform="tpu",
+                                flash_block=2048),
+         "stderr": "backend up\ncompile+step in 31s"},
+        *_stage_children(),
+    ])
+    assert built[2]["env"]["PHOTON_BENCH_FLASH_BLOCK"] == "1024"
 
 
 def test_stage_budget_zero_skips_all_stages(bench, scripted, monkeypatch):
