@@ -57,19 +57,22 @@ def _w(arr: np.ndarray) -> "Any":
     return torch.from_numpy(a)
 
 
-def llama_state_dict(params: Any, cfg: ModelConfig) -> dict:
-    """HF ``LlamaForCausalLM`` state dict from a llama-family param tree."""
-    if not cfg.rope or cfg.norm != "rmsnorm" or cfg.mlp != "swiglu":
+def _hf_llama_family_common(params: Any, cfg: ModelConfig, kind: str,
+                            mlp_emit) -> dict:
+    """Embed/attention/norm/head tensors shared by the llama and mixtral
+    exporters (HF ``model.layers.{i}`` naming); ``mlp_emit(sd, prefix, i)``
+    fills in each layer's MLP block."""
+    if not cfg.rope or cfg.norm != "rmsnorm":
         raise ValueError(
-            "llama export needs rope=true, norm=rmsnorm, mlp=swiglu "
-            f"(got rope={cfg.rope}, norm={cfg.norm}, mlp={cfg.mlp})"
+            f"{kind} export needs rope=true, norm=rmsnorm "
+            f"(got rope={cfg.rope}, norm={cfg.norm})"
         )
     if cfg.tie_embeddings:
-        raise ValueError("llama export expects tie_embeddings=false")
+        raise ValueError(f"{kind} export expects tie_embeddings=false")
     if not cfg.no_bias:
         # trained bias tensors would be silently zero-initialized by
         # from_pretrained (missing keys only warn) — refuse instead
-        raise ValueError("llama export supports no_bias=true configs only")
+        raise ValueError(f"{kind} export supports no_bias=true configs only")
     blocks = params["blocks"]["block"]
     sd: dict = {"model.embed_tokens.weight": _w(params["wte"]["embedding"])}
     for i in range(cfg.n_layers):
@@ -85,14 +88,26 @@ def llama_state_dict(params: Any, cfg: ModelConfig) -> dict:
         sd[p + "self_attn.k_proj.weight"] = _t(k)
         sd[p + "self_attn.v_proj.weight"] = _t(v)
         sd[p + "self_attn.o_proj.weight"] = _t(blocks["out_proj"]["kernel"][i])
-        sd[p + "mlp.gate_proj.weight"] = _t(blocks["gate_proj"]["kernel"][i])
-        sd[p + "mlp.up_proj.weight"] = _t(blocks["up_proj"]["kernel"][i])
-        sd[p + "mlp.down_proj.weight"] = _t(blocks["down_proj"]["kernel"][i])
+        mlp_emit(sd, p, i)
         sd[p + "input_layernorm.weight"] = _w(blocks["ln_1"]["scale"][i])
         sd[p + "post_attention_layernorm.weight"] = _w(blocks["ln_2"]["scale"][i])
     sd["model.norm.weight"] = _w(params["ln_f"]["scale"])
     sd["lm_head.weight"] = _t(params["lm_head"]["kernel"])
     return sd
+
+
+def llama_state_dict(params: Any, cfg: ModelConfig) -> dict:
+    """HF ``LlamaForCausalLM`` state dict from a llama-family param tree."""
+    if cfg.mlp != "swiglu":
+        raise ValueError(f"llama export needs mlp=swiglu (got mlp={cfg.mlp})")
+    blocks = params["blocks"]["block"]
+
+    def mlp(sd, p, i):
+        sd[p + "mlp.gate_proj.weight"] = _t(blocks["gate_proj"]["kernel"][i])
+        sd[p + "mlp.up_proj.weight"] = _t(blocks["up_proj"]["kernel"][i])
+        sd[p + "mlp.down_proj.weight"] = _t(blocks["down_proj"]["kernel"][i])
+
+    return _hf_llama_family_common(params, cfg, "llama", mlp)
 
 
 def llama_hf_config(cfg: ModelConfig, bos_token_id: int = 0,
@@ -123,6 +138,75 @@ def llama_hf_config(cfg: ModelConfig, bos_token_id: int = 0,
         "tie_word_embeddings": False,
         "torch_dtype": "float32",
     }
+
+
+def mixtral_state_dict(params: Any, cfg: ModelConfig) -> dict:
+    """HF ``MixtralForCausalLM`` state dict from a llama-family MoE tree.
+
+    Layout match: photon-tpu's SwiGLU experts (``moe_gate``/``moe_up``/
+    ``moe_down``) are exactly Mixtral's w1/w3/w2, and the router is
+    ``block_sparse_moe.gate``. Routing math matches too (softmax → top-k →
+    renormalize); Mixtral has no capacity concept, so exact logit parity
+    needs a capacity_factor ≥ E/top_k (drop-free routing) — the exporter
+    does not enforce that, it is a property of the eval batch.
+    """
+    if cfg.mlp != "moe" or cfg.moe_mlp_act != "swiglu":
+        raise ValueError(
+            "mixtral export needs mlp='moe' with moe_mlp_act='swiglu' "
+            f"(got mlp={cfg.mlp}, moe_mlp_act={cfg.moe_mlp_act})"
+        )
+    blocks = params["blocks"]["block"]
+
+    def mlp(sd, p, i):
+        sd[p + "block_sparse_moe.gate.weight"] = _t(blocks["router"][i])
+        for e in range(cfg.moe_num_experts):
+            ep = p + f"block_sparse_moe.experts.{e}."
+            sd[ep + "w1.weight"] = _t(blocks["moe_gate"][i, e])
+            sd[ep + "w3.weight"] = _t(blocks["moe_up"][i, e])
+            sd[ep + "w2.weight"] = _t(blocks["moe_down"][i, e])
+
+    return _hf_llama_family_common(params, cfg, "mixtral", mlp)
+
+
+def mixtral_hf_config(cfg: ModelConfig, bos_token_id: int = 0,
+                      eos_token_id: int = 0) -> dict:
+    hidden = cfg.mlp_hidden_size or cfg.expansion_ratio * cfg.d_model
+    return {
+        "bos_token_id": bos_token_id,
+        "eos_token_id": eos_token_id,
+        "architectures": ["MixtralForCausalLM"],
+        "model_type": "mixtral",
+        "hidden_size": cfg.d_model,
+        "intermediate_size": hidden,
+        "num_hidden_layers": cfg.n_layers,
+        "num_attention_heads": cfg.n_heads,
+        "num_key_value_heads": cfg.n_kv_heads or cfg.n_heads,
+        "head_dim": cfg.d_head,
+        "max_position_embeddings": cfg.max_seq_len,
+        "vocab_size": cfg.vocab_size,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.norm_eps,
+        "num_local_experts": cfg.moe_num_experts,
+        "num_experts_per_tok": cfg.moe_top_k,
+        "router_aux_loss_coef": cfg.moe_aux_weight,
+        "hidden_act": "silu",
+        "attention_bias": False,
+        "tie_word_embeddings": False,
+        "torch_dtype": "float32",
+    }
+
+
+def save_hf_mixtral(params: Any, cfg: ModelConfig, out_dir: str,
+                    bos_token_id: int = 0, eos_token_id: int = 0) -> pathlib.Path:
+    import torch
+
+    out = pathlib.Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    (out / "config.json").write_text(
+        json.dumps(mixtral_hf_config(cfg, bos_token_id, eos_token_id), indent=2)
+    )
+    torch.save(mixtral_state_dict(params, cfg), out / "pytorch_model.bin")
+    return out
 
 
 def foundry_mpt_state_dict(params: Any, cfg: ModelConfig) -> dict:
@@ -189,7 +273,8 @@ def main(argv: list[str] | None = None) -> None:
     src.add_argument("--preset")
     src.add_argument("--config")
     ap.add_argument("--out", required=True)
-    ap.add_argument("--format", default="llama", choices=["llama", "mpt-foundry"])
+    ap.add_argument("--format", default="llama",
+                    choices=["llama", "mixtral", "mpt-foundry"])
     ap.add_argument("--bos-token-id", type=int, default=0)
     ap.add_argument("--eos-token-id", type=int, default=0)
     args = ap.parse_args(argv)
@@ -212,6 +297,9 @@ def main(argv: list[str] | None = None) -> None:
     if args.format == "llama":
         out = save_hf_llama(params, cfg.model, args.out,
                             args.bos_token_id, args.eos_token_id)
+    elif args.format == "mixtral":
+        out = save_hf_mixtral(params, cfg.model, args.out,
+                              args.bos_token_id, args.eos_token_id)
     else:
         out = save_foundry_mpt(params, cfg.model, args.out)
     print(json.dumps({"format": args.format, "out": str(out),

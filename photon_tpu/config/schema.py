@@ -73,6 +73,7 @@ class ModelConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01  # Switch load-balance loss weight
+    moe_mlp_act: str = "gelu"  # gelu | swiglu (Mixtral-style gated experts)
     attn_impl: str = AttnImpl.PALLAS.value
     # Numerics: params kept fp32, compute in bf16 (reference: amp_bf16 + FSDP
     # PURE mixed precision, ``mpt-125m.yaml:85-92``).
@@ -394,6 +395,8 @@ class Config:
         if self.model.mlp == "moe":
             if self.model.moe_num_experts < 2:
                 raise ValueError("mlp='moe' needs moe_num_experts >= 2")
+            if self.model.moe_mlp_act not in ("gelu", "swiglu"):
+                raise ValueError(f"bad moe_mlp_act {self.model.moe_mlp_act}")
             if not 1 <= self.model.moe_top_k <= self.model.moe_num_experts:
                 raise ValueError("moe_top_k must be in [1, moe_num_experts]")
             if self.mesh.expert > 1 \

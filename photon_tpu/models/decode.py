@@ -108,6 +108,7 @@ def _mlp(lp: dict, x: jax.Array, cfg: ModelConfig,
 
         out, _ = moe_mlp(
             h, lp["router"], lp["moe_up"], lp["moe_down"],
+            w_gate=lp.get("moe_gate"),
             top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
             token_mask=token_mask,
         )

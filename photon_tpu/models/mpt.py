@@ -194,8 +194,13 @@ class MPTBlock(nn.Module):
                 "moe_down",
                 nn.initializers.normal(stddev=resid_std),
                 (cfg.moe_num_experts, hidden, cfg.d_model), pd)
+            w_gate = None
+            if cfg.moe_mlp_act == "swiglu":  # Mixtral-style gated experts
+                w_gate = self.param(
+                    "moe_gate", init,
+                    (cfg.moe_num_experts, cfg.d_model, hidden), pd)
             moe_out, aux = moe_mlp(
-                h.astype(compute), router_w, w_up, w_down,
+                h.astype(compute), router_w, w_up, w_down, w_gate=w_gate,
                 top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
             )
             self.sow("intermediates", "moe_aux", aux)

@@ -96,7 +96,8 @@ def route(probs: jax.Array, top_k: int, capacity: int,
 
 def moe_mlp(x: jax.Array, router_w: jax.Array, w_up: jax.Array,
             w_down: jax.Array, *, top_k: int, capacity_factor: float,
-            act=jax.nn.gelu, token_mask: jax.Array | None = None):
+            w_gate: jax.Array | None = None,
+            token_mask: jax.Array | None = None):
     """Expert-parallel MLP over ``[B, S, D]`` activations.
 
     ``router_w``: ``[D, E]``; ``w_up``: ``[E, D, H]``; ``w_down``:
@@ -118,7 +119,13 @@ def moe_mlp(x: jax.Array, router_w: jax.Array, w_up: jax.Array,
     dispatch = dispatch.astype(x.dtype)
     combine = combine.astype(x.dtype)
     expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf)
-    h = act(jnp.einsum("ecd,edh->ech", expert_in, w_up.astype(x.dtype)))
+    up = jnp.einsum("ecd,edh->ech", expert_in, w_up.astype(x.dtype))
+    if w_gate is not None:
+        # SwiGLU experts (Mixtral layout: w1=gate, w3=up, w2=down)
+        gate = jnp.einsum("ecd,edh->ech", expert_in, w_gate.astype(x.dtype))
+        h = jax.nn.silu(gate) * up
+    else:
+        h = jax.nn.gelu(up)
     expert_out = jnp.einsum("ech,ehd->ecd", h, w_down.astype(x.dtype))
     out = jnp.einsum("nec,ecd->nd", combine, expert_out)
     return out.reshape(*lead, d), aux

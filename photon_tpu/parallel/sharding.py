@@ -39,7 +39,7 @@ _RULES: list[tuple[str, P]] = [
     # MoE (ops/moe.py): experts shard over `expert`; inner dims follow the
     # dense column/row-parallel convention
     (r"router$", P("pipe", "fsdp", None)),
-    (r"moe_up$", P("pipe", "expert", "fsdp", "tensor")),
+    (r"(moe_up|moe_gate)$", P("pipe", "expert", "fsdp", "tensor")),
     (r"moe_down$", P("pipe", "expert", "tensor", "fsdp")),
     (r"(wqkv|up_proj|gate_proj|q_proj|k_proj|v_proj)/kernel$", P("pipe", "fsdp", "tensor")),
     (r"(out_proj|down_proj)/kernel$", P("pipe", "tensor", "fsdp")),

@@ -41,6 +41,49 @@ def test_llama_export_logit_parity(tmp_path, n_kv):
     np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)
 
 
+def test_mixtral_export_logit_parity(tmp_path):
+    """SwiGLU-expert MoE maps onto HF MixtralForCausalLM exactly: same
+    routing math (softmax -> top-k -> renorm), w1/w3/w2 expert layout.
+    Mixtral has no capacity concept, so parity needs drop-free routing —
+    capacity_factor = E/top_k guarantees every token keeps its experts."""
+    from photon_tpu.checkpoint.hf_export import save_hf_mixtral
+    from photon_tpu.models.mpt import MPTModel, init_params
+
+    cfg = tiny_llama_config(n_kv_heads=2)
+    cfg.model.mlp = "moe"
+    cfg.model.moe_mlp_act = "swiglu"
+    cfg.model.moe_num_experts = 4
+    cfg.model.moe_top_k = 2
+    cfg.model.moe_capacity_factor = 2.0  # E/k: drop-free
+    cfg.validate()
+    params = init_params(cfg.model, seed=3)
+    model = MPTModel(cfg.model)
+    tokens = np.random.default_rng(0).integers(0, 96, (2, 12), dtype=np.int32)
+    ours = np.asarray(model.apply({"params": params}, tokens))
+
+    out = save_hf_mixtral(params, cfg.model, str(tmp_path / "hf"))
+    hf = transformers.MixtralForCausalLM.from_pretrained(
+        str(out), torch_dtype=torch.float32
+    )
+    hf.eval()
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)
+
+
+def test_mixtral_export_rejects_gelu_experts():
+    from photon_tpu.checkpoint.hf_export import mixtral_state_dict
+    from photon_tpu.models.mpt import init_params
+
+    cfg = tiny_llama_config()
+    cfg.model.mlp = "moe"
+    cfg.model.moe_num_experts = 4
+    cfg.validate()  # default moe_mlp_act=gelu
+    with pytest.raises(ValueError, match="moe_mlp_act='swiglu'"):
+        mixtral_state_dict(init_params(cfg.model, seed=0), cfg.model)
+
+
 def test_llama_export_rejects_mpt_config(tmp_path):
     from photon_tpu.checkpoint.hf_export import llama_state_dict
     from photon_tpu.models.mpt import init_params

@@ -83,13 +83,17 @@ def _moe_cfg(mesh: MeshConfig) -> Config:
 
 
 @pytest.mark.parametrize(
-    "mesh", [MeshConfig(expert=4), MeshConfig(data=2, expert=2),
-             MeshConfig(fsdp=2, tensor=2, expert=2)],
+    "mesh,act", [(MeshConfig(expert=4), "gelu"),
+                 (MeshConfig(data=2, expert=2), "swiglu"),
+                 (MeshConfig(fsdp=2, tensor=2, expert=2), "swiglu"),
+                 (MeshConfig(fsdp=2, tensor=2, expert=2), "gelu")],
 )
-def test_expert_parallel_matches_single_device(mesh):
+def test_expert_parallel_matches_single_device(mesh, act):
     """The expert-sharded loss/grads equal the unsharded ones — XLA's
     all_to_all dispatch is an execution detail, not a numerical change."""
     cfg = _moe_cfg(mesh)
+    cfg.model.moe_mlp_act = act
+    cfg.validate()
     model = MPTModel(cfg.model)
     params = init_params(cfg.model, seed=0)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
