@@ -32,11 +32,12 @@ from photon_tpu.config.schema import Config, ModelConfig
 
 
 def model_config_from_hf(hf_cfg: dict) -> ModelConfig:
-    """Derive the family knobs from an HF llama config.json."""
-    if hf_cfg.get("model_type") != "llama":
-        raise ValueError(f"expected model_type=llama, got {hf_cfg.get('model_type')}")
+    """Derive the family knobs from an HF llama/mixtral config.json."""
+    kind = hf_cfg.get("model_type")
+    if kind not in ("llama", "mixtral"):
+        raise ValueError(f"expected model_type=llama|mixtral, got {kind!r}")
     m = ModelConfig()
-    m.name = "llama-import"
+    m.name = f"{kind}-import"
     m.d_model = int(hf_cfg["hidden_size"])
     m.n_layers = int(hf_cfg["num_hidden_layers"])
     m.n_heads = int(hf_cfg["num_attention_heads"])
@@ -49,7 +50,24 @@ def model_config_from_hf(hf_cfg: dict) -> ModelConfig:
     m.rope_theta = float(hf_cfg.get("rope_theta", 10000.0))
     m.learned_pos_emb = False
     m.norm = "rmsnorm"
-    m.mlp = "swiglu"
+    if kind == "mixtral":
+        m.mlp = "moe"
+        m.moe_mlp_act = "swiglu"
+        m.moe_num_experts = int(hf_cfg["num_local_experts"])
+        m.moe_top_k = int(hf_cfg.get("num_experts_per_tok", 2))
+        m.moe_aux_weight = float(hf_cfg.get("router_aux_loss_coef", 0.001))
+        if hf_cfg.get("sliding_window") is not None:
+            # windowed attention would silently diverge from our full
+            # attention past the window (Mixtral-8x7B ships null here)
+            raise ValueError(
+                f"sliding_window={hf_cfg['sliding_window']} is unsupported — "
+                "only full-attention mixtral checkpoints import faithfully"
+            )
+        # Mixtral routes without capacity; a drop-free factor (E/k) keeps
+        # the imported model's forward equal to HF's
+        m.moe_capacity_factor = m.moe_num_experts / m.moe_top_k
+    else:
+        m.mlp = "swiglu"
     m.tie_embeddings = bool(hf_cfg.get("tie_word_embeddings", False))
     if m.tie_embeddings:
         raise ValueError("tied-embedding llama checkpoints are not supported yet")
@@ -118,12 +136,24 @@ def llama_params_from_hf(sd: dict, cfg: ModelConfig) -> Any:
 
     block: dict = {
         "out_proj": {"kernel": stack("model.layers.{i}.self_attn.o_proj.weight")},
-        "gate_proj": {"kernel": stack("model.layers.{i}.mlp.gate_proj.weight")},
-        "up_proj": {"kernel": stack("model.layers.{i}.mlp.up_proj.weight")},
-        "down_proj": {"kernel": stack("model.layers.{i}.mlp.down_proj.weight")},
         "ln_1": {"scale": stack("model.layers.{i}.input_layernorm.weight", False)},
         "ln_2": {"scale": stack("model.layers.{i}.post_attention_layernorm.weight", False)},
     }
+    if cfg.mlp == "moe":
+        # Mixtral block_sparse_moe: gate=router, experts w1/w3/w2
+        E = cfg.moe_num_experts
+        block["router"] = stack("model.layers.{i}.block_sparse_moe.gate.weight")
+        for ours, theirs in (("moe_gate", "w1"), ("moe_up", "w3"),
+                             ("moe_down", "w2")):
+            block[ours] = np.stack([
+                np.stack([t(f"model.layers.{i}.block_sparse_moe.experts.{e}."
+                            f"{theirs}.weight") for e in range(E)])
+                for i in range(L)
+            ])
+    else:
+        block["gate_proj"] = {"kernel": stack("model.layers.{i}.mlp.gate_proj.weight")}
+        block["up_proj"] = {"kernel": stack("model.layers.{i}.mlp.up_proj.weight")}
+        block["down_proj"] = {"kernel": stack("model.layers.{i}.mlp.down_proj.weight")}
     q = stack("model.layers.{i}.self_attn.q_proj.weight")
     k = stack("model.layers.{i}.self_attn.k_proj.weight")
     v = stack("model.layers.{i}.self_attn.v_proj.weight")
@@ -150,7 +180,8 @@ def load_hf_llama(hf_dir: str, cfg: ModelConfig | None = None) -> tuple[ModelCon
     derived = model_config_from_hf(hf_cfg)
     if cfg is not None:
         for field in ("d_model", "n_layers", "n_heads", "vocab_size",
-                      "n_kv_heads", "mlp_hidden_size"):
+                      "n_kv_heads", "mlp_hidden_size", "mlp",
+                      "moe_num_experts", "moe_top_k", "moe_mlp_act"):
             if getattr(cfg, field) != getattr(derived, field):
                 raise ValueError(
                     f"config mismatch on {field}: yours={getattr(cfg, field)} "

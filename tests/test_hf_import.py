@@ -43,6 +43,65 @@ def test_export_import_roundtrip_bit_identical(tmp_path, n_kv):
         np.testing.assert_array_equal(np.asarray(leaf), imp_flat[path], err_msg=str(path))
 
 
+def test_mixtral_export_import_roundtrip_bit_identical(tmp_path):
+    """Our mixtral export feeds our mixtral import: the param tree comes
+    back bit-identical and the derived config carries the MoE knobs."""
+    import jax
+
+    from photon_tpu.checkpoint.hf_export import save_hf_mixtral
+    from photon_tpu.checkpoint.hf_import import load_hf_llama
+    from photon_tpu.models.mpt import init_params
+
+    cfg = tiny_llama_config(2)
+    cfg.model.mlp = "moe"
+    cfg.model.moe_mlp_act = "swiglu"
+    cfg.model.moe_num_experts = 4
+    cfg.model.moe_top_k = 2
+    cfg.validate()
+    params = init_params(cfg.model, seed=5)
+    out = save_hf_mixtral(params, cfg.model, str(tmp_path / "hf"))
+    derived, imported = load_hf_llama(str(out))
+
+    assert derived.mlp == "moe" and derived.moe_mlp_act == "swiglu"
+    assert derived.moe_num_experts == 4 and derived.moe_top_k == 2
+    assert derived.moe_capacity_factor == 2.0  # E/k: drop-free like HF
+
+    orig_leaves = jax.tree_util.tree_leaves_with_path(params)
+    imp_flat = dict(jax.tree_util.tree_leaves_with_path(imported))
+    assert len(orig_leaves) == len(imp_flat)
+    for path, leaf in orig_leaves:
+        np.testing.assert_array_equal(np.asarray(leaf), imp_flat[path], err_msg=str(path))
+
+
+def test_mixtral_import_from_transformers_save_pretrained(tmp_path):
+    """A checkpoint WRITTEN BY transformers' MixtralForCausalLM imports and
+    produces the same logits in our forward — the genuine external inbound path."""
+    from photon_tpu.checkpoint.hf_import import load_hf_llama
+    from photon_tpu.models.mpt import MPTModel
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=16, rms_norm_eps=1e-5, rope_theta=10000.0,
+        num_local_experts=4, num_experts_per_tok=2,
+        tie_word_embeddings=False, torch_dtype="float32",
+    )
+    torch.manual_seed(0)
+    hf = transformers.MixtralForCausalLM(hf_cfg)
+    hf.eval()
+    hf.save_pretrained(str(tmp_path / "hf"))
+
+    derived, params = load_hf_llama(str(tmp_path / "hf"))
+    derived.attn_impl = "xla"
+    derived.compute_dtype = "float32"
+    model = MPTModel(derived)
+    tokens = np.random.default_rng(0).integers(0, 96, (2, 12), dtype=np.int32)
+    ours = np.asarray(model.apply({"params": params}, tokens))
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)
+
+
 def test_import_from_transformers_save_pretrained(tmp_path):
     """A checkpoint written by transformers itself (safetensors) imports and
     produces the same logits through OUR model as through HF."""
