@@ -17,4 +17,4 @@ Architecture (TPU-first, not a port):
   (reference: CUDA flash-attention).
 """
 
-__version__ = "0.5.2"  # single source of truth (pyproject reads it via dynamic)
+__version__ = "0.5.3"  # single source of truth (pyproject reads it via dynamic)
