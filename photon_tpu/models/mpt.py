@@ -39,22 +39,32 @@ def _dtype(name: str):
     return jnp.dtype(name)
 
 
-def _constrain_logits(logits: jax.Array) -> jax.Array:
-    """Pin the logits layout ([B,S,V]: batch over data+fsdp, seq over
-    sequence, vocab over tensor) when tracing under a mesh. Without the hint
-    SPMD can pick a batch-sharded logits layout and then involuntarily
-    rematerialize the whole tensor to reach the loss reduction."""
+def _constrain_activation(x: jax.Array, spec) -> jax.Array:
+    """``with_sharding_constraint`` against the ambient mesh (no-op when
+    tracing outside one), with indivisible axes dropped."""
     from photon_tpu.parallel.context import current_mesh
 
     mesh = current_mesh()
     if mesh is None:
-        return logits
-    from jax.sharding import NamedSharding, PartitionSpec as P
+        return x
+    from jax.sharding import NamedSharding
 
     from photon_tpu.parallel.sharding import _fit_spec
 
-    spec = _fit_spec(P(("data", "fsdp", "expert"), "sequence", "tensor"), logits.shape, mesh)
-    return jax.lax.with_sharding_constraint(logits, NamedSharding(mesh, spec))
+    fitted = _fit_spec(spec, x.shape, mesh)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, fitted))
+
+
+def _constrain_logits(logits: jax.Array) -> jax.Array:
+    """Pin the logits layout ([B,S,V]: batch over data+fsdp+expert, seq over
+    sequence, vocab over tensor) when tracing under a mesh. Without the hint
+    SPMD can pick a batch-sharded logits layout and then involuntarily
+    rematerialize the whole tensor to reach the loss reduction."""
+    from jax.sharding import PartitionSpec as P
+
+    return _constrain_activation(
+        logits, P(("data", "fsdp", "expert"), "sequence", "tensor")
+    )
 
 
 class FP32LayerNorm(nn.Module):
@@ -204,6 +214,17 @@ class MPTBlock(nn.Module):
                 top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
             )
             self.sow("intermediates", "moe_aux", aux)
+            # pin the combine output back to the residual-stream layout
+            # (batch over data+fsdp+expert; d_model REPLICATED over tensor —
+            # the residual add and the next ln_1 consume the full feature
+            # dim): without the hint GSPMD brings it back expert-major and
+            # pays an "involuntary full rematerialization" reshard at the
+            # residual add (spmd_partitioner warning on the virtual mesh)
+            from jax.sharding import PartitionSpec as P
+
+            moe_out = _constrain_activation(
+                moe_out, P(("data", "fsdp", "expert"), "sequence", None)
+            )
             return x + moe_out
         if cfg.mlp == "swiglu":
             # separate gate/up projections (standard llama layout): each is
