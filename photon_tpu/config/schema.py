@@ -405,10 +405,10 @@ class Config:
                     f"moe_num_experts={self.model.moe_num_experts} must be "
                     f"divisible by mesh.expert={self.mesh.expert}"
                 )
-            if self.mesh.pipe > 1 or self.mesh.sequence > 1:
+            if self.mesh.pipe > 1:
                 raise ValueError(
-                    "mlp='moe' composes with data/fsdp/tensor/expert mesh "
-                    "axes; pipe and sequence are not supported with MoE yet"
+                    "mlp='moe' composes with data/fsdp/tensor/sequence/"
+                    "expert mesh axes; pipe is not supported with MoE yet"
                 )
         elif self.mesh.expert > 1:
             raise ValueError("mesh.expert > 1 requires model.mlp='moe'")

@@ -86,17 +86,27 @@ def _moe_cfg(mesh: MeshConfig) -> Config:
     "mesh,act", [(MeshConfig(expert=4), "gelu"),
                  (MeshConfig(data=2, expert=2), "swiglu"),
                  (MeshConfig(fsdp=2, tensor=2, expert=2), "swiglu"),
-                 (MeshConfig(fsdp=2, tensor=2, expert=2), "gelu")],
+                 (MeshConfig(fsdp=2, tensor=2, expert=2), "gelu"),
+                 # long-context MoE: ring attention over sequence composes
+                 # with expert parallelism (the MoE einsums sit outside
+                 # ring's shard_map)
+                 (MeshConfig(data=2, sequence=2, expert=2), "gelu")],
 )
 def test_expert_parallel_matches_single_device(mesh, act):
     """The expert-sharded loss/grads equal the unsharded ones — XLA's
     all_to_all dispatch is an execution detail, not a numerical change."""
+    from photon_tpu.parallel.context import use_mesh
+
     cfg = _moe_cfg(mesh)
     cfg.model.moe_mlp_act = act
+    if mesh.sequence > 1:
+        cfg.model.max_seq_len = 64  # give the ring something to shard
+        cfg.model.attn_impl = "ring"
     cfg.validate()
     model = MPTModel(cfg.model)
     params = init_params(cfg.model, seed=0)
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (8, cfg.model.max_seq_len), 0, 64)
     loss_fn = make_loss_fn(model, 2048)
     l_ref, g_ref = jax.value_and_grad(loss_fn)(params, tokens)
 
@@ -106,7 +116,8 @@ def test_expert_parallel_matches_single_device(mesh, act):
     sh = state_shardings(st, m)
     ps = jax.tree.map(lambda l, s: jax.device_put(l, s), st.params, sh.params)
     tok_s = jax.device_put(tokens, NamedSharding(m, batch_spec(m)))
-    l_sh, g_sh = jax.jit(jax.value_and_grad(loss_fn))(ps, tok_s)
+    with use_mesh(m):
+        l_sh, g_sh = jax.jit(jax.value_and_grad(loss_fn))(ps, tok_s)
     assert float(l_sh) == pytest.approx(float(l_ref), abs=1e-5)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(
@@ -140,7 +151,7 @@ def test_moe_validation():
         cfg = Config()
         cfg.mesh = MeshConfig(expert=2)
         cfg.validate()
-    with pytest.raises(ValueError, match="pipe and sequence"):
+    with pytest.raises(ValueError, match="pipe is not supported"):
         cfg = Config()
         cfg.model.mlp = "moe"
         cfg.model.moe_num_experts = 4
