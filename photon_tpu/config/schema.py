@@ -351,15 +351,18 @@ class Config:
                     "ring attention's shard_map cannot nest inside the "
                     "pipeline's manual pipe axis"
                 )
-            if self.mesh.data > 1 and self.mesh.fsdp > 1:
+            n_batch_axes = sum(
+                a > 1 for a in (self.mesh.data, self.mesh.fsdp,
+                                self.mesh.expert)
+            )
+            if n_batch_axes > 1:
                 raise ValueError(
-                    "mesh.pipe > 1 supports at most one batch axis > 1 "
-                    "(data OR fsdp): the compound (data, fsdp) batch "
-                    "sharding inside the partial-manual pipeline region "
-                    "hits an XLA SPMD partitioner CHECK failure "
+                    "mesh.pipe > 1 supports at most ONE batch-sharded axis "
+                    "> 1 (data, fsdp, or expert): compound batch sharding "
+                    "inside the partial-manual pipeline region hits an XLA "
+                    "SPMD partitioner CHECK failure "
                     "(spmd_partitioner_util.cc group-count assertion). "
-                    "Fold the batch parallelism into one axis, e.g. "
-                    "fsdp=data*fsdp, data=1"
+                    "Fold the batch parallelism into one axis"
                 )
             if self.model.attn_impl == AttnImpl.PALLAS.value:
                 # the pallas dispatch shard_maps over batch/head axes, which
@@ -405,11 +408,7 @@ class Config:
                     f"moe_num_experts={self.model.moe_num_experts} must be "
                     f"divisible by mesh.expert={self.mesh.expert}"
                 )
-            if self.mesh.pipe > 1:
-                raise ValueError(
-                    "mlp='moe' composes with data/fsdp/tensor/sequence/"
-                    "expert mesh axes; pipe is not supported with MoE yet"
-                )
+
         elif self.mesh.expert > 1:
             raise ValueError("mesh.expert > 1 requires model.mlp='moe'")
         if self.model.rope and self.model.d_head % 2:

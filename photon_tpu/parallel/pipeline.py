@@ -48,6 +48,7 @@ from photon_tpu.train.train_step import (
     TrainState,
     _chunked_ce_sum,
     _output_embedding,
+    collect_moe_aux,
 )
 
 
@@ -64,7 +65,7 @@ def _batch_constrain(x: jax.Array, mesh: Mesh) -> jax.Array:
     from photon_tpu.parallel.sharding import _fit_spec
 
     spec = _fit_spec(
-        P(("data", "fsdp"), *([None] * (x.ndim - 1))), x.shape, mesh
+        P(("data", "fsdp", "expert"), *([None] * (x.ndim - 1))), x.shape, mesh
     )
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
@@ -107,8 +108,12 @@ def _tail_ce_mean(
     return jnp.mean(ce)
 
 
-def _stage_apply(cfg: ModelConfig, slab: Any, x: jax.Array) -> jax.Array:
+def _stage_apply(cfg: ModelConfig, slab: Any, x: jax.Array):
     """Run this stage's ``[Lp, ...]`` layer slab (scan over local layers).
+    Returns ``(y, aux)`` where ``aux`` is the stage's summed MoE
+    load-balance loss (0.0 for dense models) — the pipeline collects the
+    per-layer ``moe_aux`` sows explicitly because the stage scan applies
+    blocks outside flax's ``nn.scan`` plumbing.
 
     With ``cfg.remat`` the pipeline remats at BOTH levels: the tick
     checkpoint saves only the stage-boundary activation per tick, and the
@@ -121,15 +126,20 @@ def _stage_apply(cfg: ModelConfig, slab: Any, x: jax.Array) -> jax.Array:
     block = MPTBlock(cfg)
 
     def body(carry, layer_params):
-        return block.apply({"params": layer_params}, carry), None
+        x, aux_acc = carry
+        y, variables = block.apply(
+            {"params": layer_params}, x, mutable=["intermediates"]
+        )
+        aux_acc = aux_acc + collect_moe_aux(variables.get("intermediates", {}))
+        return (y, aux_acc), None
 
     if cfg.remat:
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.nothing_saveable,
             prevent_cse=False,
         )
-    x, _ = jax.lax.scan(body, x, slab)
-    return x
+    (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros([], jnp.float32)), slab)
+    return x, aux
 
 
 def make_pipeline_train_step(
@@ -182,7 +192,7 @@ def make_pipeline_train_step(
                 # the same CHECK-abort family the embed sharding
                 # constraint works around (_batch_constrain).
                 x = jnp.where(idx == 0, _embed(cfg, others, tok_in, mesh), buf)
-                y = _stage_apply(cfg, blocks["block"], x)
+                y, stage_aux = _stage_apply(cfg, blocks["block"], x)
                 # last stage: microbatch t-(P-1) exits the pipe this tick
                 ce = _tail_ce_mean(
                     model, full, _final_norm(cfg, others, y), tok_out,
@@ -190,6 +200,13 @@ def make_pipeline_train_step(
                 )
                 live = (idx == n_stages - 1) & (t >= n_stages - 1)
                 ce_sum = ce_sum + jnp.where(live, ce, 0.0)
+                # this stage processed microbatch t-idx this tick; its MoE
+                # aux counts only when that microbatch is real (not a
+                # pipeline bubble)
+                carried = (t >= idx) & (t - idx < n_micro)
+                ce_sum = ce_sum + jnp.where(
+                    carried, cfg.moe_aux_weight * stage_aux, 0.0
+                )
                 buf = jax.lax.ppermute(
                     y, "pipe",
                     [(i, (i + 1) % n_stages) for i in range(n_stages)],

@@ -79,6 +79,18 @@ def _chunked_ce_sum(
     return total
 
 
+def collect_moe_aux(variables: Any) -> jax.Array:
+    """Sum the per-layer ``moe_aux`` sows out of an ``intermediates``
+    collection (and ONLY those — other sown diagnostics must not leak
+    into the objective). Shared by the standard loss fn and the pipeline
+    stage scan."""
+    aux = jnp.zeros([], jnp.float32)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(variables or {}):
+        if any(getattr(k, "key", None) == "moe_aux" for k in path):
+            aux = aux + jnp.sum(jnp.asarray(leaf, jnp.float32))
+    return aux
+
+
 def _apply_collecting_aux(model: MPTModel, params, tokens, **kwargs):
     """``model.apply`` that also returns the summed MoE aux loss (0.0 for
     dense models). The MoE blocks sow per-layer Switch load-balance terms
@@ -89,14 +101,7 @@ def _apply_collecting_aux(model: MPTModel, params, tokens, **kwargs):
     out, variables = model.apply(
         {"params": params}, tokens, mutable=["intermediates"], **kwargs
     )
-    # fold ONLY the moe_aux entries into the objective — any other sown
-    # diagnostic (e.g. router stats for logging) must not leak into loss
-    aux = jnp.zeros([], jnp.float32)
-    for path, leaf in jax.tree_util.tree_leaves_with_path(
-        variables.get("intermediates", {})
-    ):
-        if any(getattr(k, "key", None) == "moe_aux" for k in path):
-            aux = aux + jnp.sum(jnp.asarray(leaf, jnp.float32))
+    aux = collect_moe_aux(variables.get("intermediates", {}))
     return out, model.cfg.moe_aux_weight * aux
 
 
