@@ -152,19 +152,42 @@ def _relay_preflight() -> None:
     sys.exit(3)
 
 
+USAGE = """usage: tpu_probe.py --auto [gbs]
+       tpu_probe.py remat,micro,gbs,steps[,impl[,block]] ...
+e.g.:  tpu_probe.py 0,2,16,6,pallas,1024
+Validates args BEFORE claiming the (single-claimant) TPU backend."""
+
+
+def _parse_specs(argv: list[str]) -> list[tuple]:
+    specs = []
+    for spec in argv:
+        parts = spec.split(",")
+        try:
+            remat, micro, gbs, steps = (int(x) for x in parts[:4])
+        except ValueError:
+            raise SystemExit(f"bad config spec {spec!r}\n{USAGE}") from None
+        impl = parts[4] if len(parts) > 4 else "pallas"
+        block = int(parts[5]) if len(parts) > 5 else 0
+        specs.append((remat, micro, gbs, steps, impl, block))
+    return specs
+
+
 def main() -> None:
+    # parse FIRST: a bad arg must not cost a relay claim (the chip grant is
+    # single-claimant; an argv crash after jax.devices() wastes/wedges it)
+    if sys.argv[1:] and sys.argv[1] in ("-h", "--help"):
+        print(USAGE)
+        return
+    auto_mode = bool(sys.argv[1:]) and sys.argv[1] == "--auto"
+    specs = [] if auto_mode else _parse_specs(sys.argv[1:])
     _relay_preflight()
     dev = jax.devices()[0]
     log(f"device: {dev} kind={dev.device_kind}")
-    if sys.argv[1:] and sys.argv[1] == "--auto":
+    if auto_mode:
         auto(int(sys.argv[2]) if len(sys.argv) > 2 else 256)
         return
     results = []
-    for spec in sys.argv[1:]:
-        parts = spec.split(",")
-        remat, micro, gbs, steps = (int(x) for x in parts[:4])
-        impl = parts[4] if len(parts) > 4 else "pallas"
-        block = int(parts[5]) if len(parts) > 5 else 0
+    for remat, micro, gbs, steps, impl, block in specs:
         log(f"--- config remat={bool(remat)} micro={micro} gbs={gbs} steps={steps} impl={impl} block={block}")
         try:
             r = probe(bool(remat), micro, gbs, steps, impl, block)
