@@ -1183,6 +1183,73 @@ def one_b_memory_probe(dev) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Wire compression (host-side; lands in the BENCH_*.json schema)
+# ---------------------------------------------------------------------------
+
+
+def wire_compression_report(model_cfg, budget_bytes: int = 64 << 20) -> dict | None:
+    """Per-round payload bytes (raw vs. compressed) for this bench model
+    through the ``photon_tpu/compression`` codec pipeline.
+
+    Pure host/numpy work — no device time. Layer shapes come from an
+    abstract ``init_params`` eval_shape; a deterministic subset of layers up
+    to ``budget_bytes`` is actually encoded (synthetic N(0, 1e-3) round
+    deltas) and the measured ratio projects the full payload, so the 125M
+    recipe doesn't cost a 0.5 GB encode inside the bench window. Keys:
+    ``raw_bytes_per_client_round`` (exact, from metadata) and per-policy
+    ``{ratio, projected_bytes_per_client_round}``."""
+    try:
+        import jax
+        import numpy as np
+
+        from photon_tpu.codec import ParamsMetadata, flatten_params
+        from photon_tpu.compression import Codec
+        from photon_tpu.models.mpt import init_params
+
+        abstract = jax.eval_shape(lambda: init_params(model_cfg, seed=0))
+        names, leaves = flatten_params(abstract)
+        shapes = [tuple(l.shape) for l in leaves]
+        raw_total = sum(
+            int(np.prod(s, dtype=np.int64)) * 4 for s in shapes  # fp32 wire
+        )
+
+        rng = np.random.default_rng(0)
+        sample_names, sample_arrays, sampled = [], [], 0
+        for name, shape in zip(names, shapes):
+            nbytes = int(np.prod(shape, dtype=np.int64)) * 4
+            if sampled + nbytes > budget_bytes and sample_arrays:
+                continue
+            sample_names.append(name)
+            sample_arrays.append(rng.normal(0, 0.02, shape).astype(np.float32))
+            sampled += nbytes
+        ref = [a + rng.normal(0, 1e-3, a.shape).astype(np.float32)
+               for a in sample_arrays]
+        meta = ParamsMetadata.from_ndarrays(sample_names, sample_arrays)
+
+        report: dict = {
+            "raw_bytes_per_client_round": raw_total,
+            "sampled_bytes": sampled,
+            "policies": {},
+        }
+        for policy in ("delta_q8", "delta_topk_q8"):
+            codec = Codec(policy, topk_ratio=0.125, error_feedback=False)
+            codec.set_reference(ref)
+            t0 = time.perf_counter()
+            payload = codec.encode(meta, sample_arrays)
+            ratio = payload.compression_ratio
+            report["policies"][policy] = {
+                "ratio": round(ratio, 2),
+                "projected_bytes_per_client_round": int(raw_total / ratio),
+                "encode_s": round(time.perf_counter() - t0, 2),
+            }
+        report["topk_ratio"] = 0.125
+        return report
+    except Exception as e:  # noqa: BLE001 — never cost the round its numbers
+        log(f"wire compression report failed: {type(e).__name__}: {e}")
+        return None
+
+
+# ---------------------------------------------------------------------------
 # The actual bench (child process)
 # ---------------------------------------------------------------------------
 
@@ -1494,6 +1561,15 @@ def run(platform: str) -> None:
                 c.train.loss_chunk_tokens = n  # carry the chunk-trial win
             upgrade_trial(f"block-qk-{bq_t}x{bk_t} trial", micro, _qk,
                           {"flash_block": bq_t, "flash_block_k": bk_t})
+
+    # wire-cost telemetry (host-side, no device time): per-round payload
+    # bytes raw vs. compressed through the parameter-plane codec, so the
+    # perf trajectory tracks wire cost alongside tokens/sec
+    if os.environ.get("PHOTON_BENCH_SKIP_WIRE") != "1":
+        wc = wire_compression_report(cfg.model)
+        if wc is not None:
+            out["wire_compression"] = wc
+            emit(out)
 
     # under the supervisor (PHOTON_BENCH_ORCHESTRATED) parity and the
     # evidence stages run in their own child processes with fresh relay

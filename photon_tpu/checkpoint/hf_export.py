@@ -155,6 +155,18 @@ def mixtral_state_dict(params: Any, cfg: ModelConfig) -> dict:
             "mixtral export needs mlp='moe' with moe_mlp_act='swiglu' "
             f"(got mlp={cfg.mlp}, moe_mlp_act={cfg.moe_mlp_act})"
         )
+    drop_free = cfg.moe_num_experts / cfg.moe_top_k
+    if cfg.moe_capacity_factor < drop_free:
+        import warnings
+
+        warnings.warn(
+            f"mixtral export: moe_capacity_factor={cfg.moe_capacity_factor} "
+            f"< moe_num_experts/moe_top_k={drop_free:g}: this model was "
+            "trained with capacity-dropped routing, but HF Mixtral routes "
+            "drop-free — exported logits will diverge from training-time "
+            "behavior on batches that overflow expert capacity",
+            stacklevel=2,
+        )
     blocks = params["blocks"]["block"]
 
     def mlp(sd, p, i):

@@ -81,13 +81,18 @@ class ParamsMetadata:
         )
 
     @classmethod
-    def from_json(cls, s: str) -> "ParamsMetadata":
-        d = json.loads(s)
+    def from_dict(cls, d: dict) -> "ParamsMetadata":
+        """Build from an already-parsed manifest dict (unknown keys — e.g.
+        the transport's ``codec`` wire-form header — are ignored)."""
         return cls(
             names=tuple(d["names"]),
             shapes=tuple(tuple(s) for s in d["shapes"]),
             dtypes=tuple(d["dtypes"]),
         )
+
+    @classmethod
+    def from_json(cls, s: str) -> "ParamsMetadata":
+        return cls.from_dict(json.loads(s))
 
     @classmethod
     def from_ndarrays(cls, names: Iterable[str], arrays: Iterable[np.ndarray]) -> "ParamsMetadata":

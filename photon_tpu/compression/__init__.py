@@ -1,0 +1,52 @@
+"""Wire-compression subsystem for the federation parameter plane.
+
+Photon's headline claim is communication efficiency: federated rounds move
+orders of magnitude fewer bytes than per-step distributed training. For
+WAN-federated clients the hardware limit of the cross-host path IS the
+network, so the uplink (client → server fit results) gets a lossy but
+error-compensated codec pipeline:
+
+- **round-delta encoding** (``delta.py``) — clients transmit
+  ``w_new − w_global`` instead of raw weights; deltas are small and centered
+  at zero, which is what makes sparsification and quantization cheap;
+- **top-k magnitude sparsification** (``topk.py``) — keep only the largest
+  fraction of each layer's delta by magnitude;
+- **blockwise int8 quantization** (``quantize.py``) — absmax-scaled int8
+  blocks with one fp32 scale per block (the EQuARX-style quantized-collective
+  trick applied to the parameter plane);
+- **error-feedback residuals** (``error_feedback.py``) — per-client memory of
+  everything the lossy stages dropped or rounded, re-injected into the next
+  round's delta so the error stays bounded instead of compounding;
+- a versioned, self-describing :class:`CompressedPayload` container
+  (``payload.py``) with per-layer scales and a JSON header;
+- the :class:`Codec` pipeline (``codec.py``) composing the stages under a
+  named policy: ``off`` / ``delta`` / ``delta_q8`` / ``delta_topk_q8``.
+
+Integration: :class:`photon_tpu.federation.transport.ParamTransport` takes a
+``compression=`` policy and applies it to fit-result payloads (the uplink);
+broadcasts stay raw so a fresh client can always join. The server-side
+strategy consumes the *compressed* stream and dequantizes one client at a
+time, keeping aggregation memory O(1) in client count.
+"""
+
+from photon_tpu.compression.codec import Codec, decode_payload, make_codec, policy_flags
+from photon_tpu.compression.error_feedback import ErrorFeedback
+from photon_tpu.compression.payload import PAYLOAD_VERSION, CompressedPayload
+from photon_tpu.compression.quantize import dequantize_q8, quantize_q8
+from photon_tpu.compression.topk import topk_sparsify
+
+POLICIES = ("off", "delta", "delta_q8", "delta_topk_q8")
+
+__all__ = [
+    "POLICIES",
+    "PAYLOAD_VERSION",
+    "Codec",
+    "CompressedPayload",
+    "ErrorFeedback",
+    "decode_payload",
+    "dequantize_q8",
+    "make_codec",
+    "policy_flags",
+    "quantize_q8",
+    "topk_sparsify",
+]

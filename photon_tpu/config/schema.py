@@ -225,6 +225,24 @@ class CommStackConfig:
 
 
 @dataclass
+class CompressionConfig:
+    """Parameter-plane wire codec (``photon_tpu/compression``).
+
+    Applied by :class:`ParamTransport` to the uplink (client fit results);
+    broadcasts stay raw so a fresh client can always join. ``policy``
+    composes the stages: round-delta encoding, top-k magnitude
+    sparsification, blockwise int8 quantization — each with per-client
+    error-feedback residuals when ``error_feedback`` is on.
+    """
+
+    policy: str = "off"  # off | delta | delta_q8 | delta_topk_q8
+    topk_ratio: float = 0.125  # kept fraction per layer (delta_topk_q8)
+    q8_block_size: int = 256  # values per fp32 absmax scale block
+    error_feedback: bool = True  # per-client residual re-injection
+    ef_max_clients: int = 16  # LRU cap on node-resident residual copies
+
+
+@dataclass
 class FLConfig:
     """Federation hyperparameters (reference: ``base_schema.py`` fl block)."""
 
@@ -273,6 +291,7 @@ class PhotonConfig:
     # ``init_utils.py:43-125``)
     init_from_run: str | None = None
     comm_stack: CommStackConfig = field(default_factory=CommStackConfig)
+    compression: CompressionConfig = field(default_factory=CompressionConfig)
     save_path: str = "/tmp/photon_tpu"
 
 
@@ -364,25 +383,11 @@ class Config:
                     "(spmd_partitioner_util.cc group-count assertion). "
                     "Fold the batch parallelism into one axis"
                 )
-            if self.model.attn_impl == AttnImpl.PALLAS.value:
-                # the pallas dispatch shard_maps over batch/head axes, which
-                # cannot nest inside the pipeline's partial-manual region
-                warnings.warn(
-                    "mesh.pipe > 1 with attn_impl=pallas: falling back to "
-                    "attn_impl=xla inside pipeline stages",
-                    stacklevel=2,
-                )
-                self.model.attn_impl = AttnImpl.XLA.value
-        if self.mesh.sequence > 1 and self.model.attn_impl == AttnImpl.PALLAS.value:
-            # a sequence-sharded mesh needs the ring (context-parallel)
-            # dispatch: the plain pallas call sees sequence-sharded operands
-            # GSPMD cannot partition (Mosaic kernels aren't auto-partitioned)
-            warnings.warn(
-                "mesh.sequence > 1 with attn_impl=pallas: upgrading to "
-                "attn_impl=ring (context-parallel flash over the sequence axis)",
-                stacklevel=2,
-            )
-            self.model.attn_impl = AttnImpl.RING.value
+            # NOTE: attn_impl=pallas under pipe > 1 is NOT mutated here:
+            # validation must not side-effect the config of record (a config
+            # serialized after validate() has to match the operator's input).
+            # The pallas→xla fallback lives in effective_model_config(),
+            # applied where steps/models are actually built.
         if self.fl.client_count_scaling not in ("none", "linear", "sqrt"):
             raise ValueError(f"bad client_count_scaling {self.fl.client_count_scaling}")
         if self.model.resid_pdrop != 0.0:
@@ -398,6 +403,13 @@ class Config:
         if self.model.mlp == "moe":
             if self.model.moe_num_experts < 2:
                 raise ValueError("mlp='moe' needs moe_num_experts >= 2")
+            if self.model.moe_capacity_factor <= 0:
+                # expert_capacity() would silently clamp every expert to
+                # capacity 1 and mass-drop tokens
+                raise ValueError(
+                    f"moe_capacity_factor must be > 0, got "
+                    f"{self.model.moe_capacity_factor}"
+                )
             if self.model.moe_mlp_act not in ("gelu", "swiglu"):
                 raise ValueError(f"bad moe_mlp_act {self.model.moe_mlp_act}")
             if not 1 <= self.model.moe_top_k <= self.model.moe_num_experts:
@@ -417,8 +429,71 @@ class Config:
             raise ValueError("n_kv_heads and mlp_hidden_size must be >= 0")
         if self.model.n_kv_heads and self.model.n_heads % self.model.n_kv_heads:
             raise ValueError("n_heads must be a multiple of n_kv_heads")
+        comp = self.photon.compression
+        from photon_tpu.compression import policy_flags
+
+        policy_flags(comp.policy)  # raises on unknown policy
+        if comp.policy == "delta":
+            # float64 deltas are LOSSLESS but ~2x the fp32 raw payload —
+            # a correctness/debug rung, not a bytes saver
+            warnings.warn(
+                "compression.policy='delta' is lossless but INFLATES the "
+                "wire ~2x on fp32 payloads (float64 deltas); use delta_q8 "
+                "or delta_topk_q8 to actually reduce bytes",
+                stacklevel=2,
+            )
+        if not 0.0 < comp.topk_ratio <= 1.0:
+            raise ValueError(
+                f"compression.topk_ratio must be in (0, 1], got {comp.topk_ratio}"
+            )
+        if comp.q8_block_size < 1:
+            raise ValueError(
+                f"compression.q8_block_size must be >= 1, got {comp.q8_block_size}"
+            )
+        if comp.ef_max_clients < 1:
+            raise ValueError(
+                f"compression.ef_max_clients must be >= 1, got {comp.ef_max_clients}"
+            )
+        if comp.policy != "off" and self.photon.comm_stack.collective:
+            raise ValueError(
+                "compression applies to the pointer planes (shm/objstore/"
+                "inline); the collective comm stack aggregates on-device and "
+                "bypasses the wire codec — set compression.policy='off'"
+            )
         _ = self.model.d_head
         return self
+
+
+def effective_model_config(model: ModelConfig, mesh: MeshConfig) -> ModelConfig:
+    """The model config a step builder should actually use for ``mesh``.
+
+    Pure function of (model, mesh) — the config of record is never mutated
+    (validation must stay side-effect free so a serialized config matches
+    the operator's input). Fallbacks, each with a warning:
+
+    - ``pipe > 1`` + pallas → xla: the pallas dispatch shard_maps over
+      batch/head axes, which cannot nest inside the pipeline's
+      partial-manual region;
+    - ``sequence > 1`` + pallas → ring: a sequence-sharded mesh needs the
+      context-parallel dispatch (the plain pallas call sees
+      sequence-sharded operands GSPMD cannot partition — Mosaic kernels
+      aren't auto-partitioned).
+    """
+    if mesh.pipe > 1 and model.attn_impl == AttnImpl.PALLAS.value:
+        warnings.warn(
+            "mesh.pipe > 1 with attn_impl=pallas: falling back to "
+            "attn_impl=xla inside pipeline stages",
+            stacklevel=2,
+        )
+        return dataclasses.replace(model, attn_impl=AttnImpl.XLA.value)
+    if mesh.sequence > 1 and model.attn_impl == AttnImpl.PALLAS.value:
+        warnings.warn(
+            "mesh.sequence > 1 with attn_impl=pallas: upgrading to "
+            "attn_impl=ring (context-parallel flash over the sequence axis)",
+            stacklevel=2,
+        )
+        return dataclasses.replace(model, attn_impl=AttnImpl.RING.value)
+    return model
 
 
 def _build_dataclass(cls: type, d: dict[str, Any]) -> Any:

@@ -88,13 +88,15 @@ def build_app(
             return NodeAgent(
                 cfg,
                 node_id,
-                make_transport=lambda: ParamTransport(mode, store=store),
+                make_transport=lambda: ParamTransport(
+                    mode, store=store, compression=cfg.photon.compression
+                ),
                 make_ckpt_mgr=lambda: ClientCheckpointManager(store, cfg.run_uuid),
             )
 
         driver = InProcessDriver(cfg, make_agent, n_nodes=n_nodes)
 
-    transport = ParamTransport(mode, store=store)
+    transport = ParamTransport(mode, store=store, compression=cfg.photon.compression)
     ckpt = ServerCheckpointManager(store, cfg.run_uuid) if cfg.photon.checkpoint else None
     from photon_tpu.metrics.history import History
 

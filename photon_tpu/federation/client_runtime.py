@@ -117,12 +117,16 @@ class ClientRuntime:
     # -- params ----------------------------------------------------------
     def set_broadcast_params(self, ptr) -> None:
         """Cache the round's global params (reference: NM params shm write,
-        ``client_app.py:104-115``)."""
+        ``client_app.py:104-115``). The broadcast doubles as the wire
+        codec's delta base: this round's fit results upload as
+        ``w_new − w_global`` against exactly these arrays."""
         self._current_params = self.transport.get(ptr, copy=True)
+        self.transport.set_reference(self._current_params[1])
 
     def _resolve_params(self, ptr) -> tuple[ParamsMetadata, list[np.ndarray]]:
         if ptr is not None:
             self._current_params = self.transport.get(ptr, copy=True)
+            self.transport.set_reference(self._current_params[1])
         if self._current_params is None:
             raise RuntimeError("no parameters: neither FitIns pointer nor prior broadcast")
         return self._current_params
@@ -275,7 +279,12 @@ class ClientRuntime:
         t_start: float,
     ) -> FitRes:
         wall = time.monotonic() - t_start
-        ptr = self.transport.put(f"fit-r{ins.server_round}-c{cid}-{self.node_id}", meta, arrays)
+        # uplink payloads go through the wire codec when one is configured
+        # (delta against this round's broadcast, EF residuals keyed by cid)
+        ptr = self.transport.put(
+            f"fit-r{ins.server_round}-c{cid}-{self.node_id}", meta, arrays,
+            compress=True, key=cid,
+        )
         new_state = ClientState(
             cid=cid,
             steps_cumulative=state_in.steps_cumulative + ins.local_steps,

@@ -22,12 +22,26 @@ from typing import Any
 @dataclass
 class ParamPointer:
     """Where the bulk tensors live (reference: the remote record written by
-    ``replace_remote_with_parameters_in_recordset``, ``s3_utils.py:730-933``)."""
+    ``replace_remote_with_parameters_in_recordset``, ``s3_utils.py:730-933``).
+
+    ``metadata_json`` always carries the ORIGINAL payload's
+    ``ParamsMetadata`` (names/shapes/dtypes). When the payload went through
+    the wire codec (``photon_tpu/compression``) the same JSON grows a
+    ``codec`` key ``{"policy", "version", "wire_nbytes"}`` describing the
+    compressed form — back-compatible, because ``ParamsMetadata.from_json``
+    reads only the keys it knows.
+    """
 
     kind: str  # "shm" | "objstore" | "inline"
     locator: str  # shm segment name or store key ("" for inline)
-    metadata_json: str  # ParamsMetadata.to_json()
+    metadata_json: str  # ParamsMetadata.to_json() (+ optional "codec" key)
     inline: list | None = None  # only for kind="inline" (tests / tiny models)
+
+    def codec_info(self) -> dict | None:
+        """The ``codec`` wire-form header, or None for raw payloads."""
+        import json
+
+        return json.loads(self.metadata_json).get("codec")
 
 
 @dataclass

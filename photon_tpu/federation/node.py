@@ -115,7 +115,9 @@ def node_process_main(cfg_json: str, node_id: str, conn, platform: str | None, n
     if platform:
         jax.config.update("jax_platforms", platform)
         if platform == "cpu" and n_cpu_devices > 1:
-            jax.config.update("jax_num_cpu_devices", n_cpu_devices)
+            from photon_tpu.utils.compat import set_cpu_device_count
+
+            set_cpu_device_count(n_cpu_devices)
 
     cfg = Config.from_json(cfg_json)
     store = None
@@ -126,7 +128,7 @@ def node_process_main(cfg_json: str, node_id: str, conn, platform: str | None, n
 
     def make_transport() -> ParamTransport:
         mode = "objstore" if cfg.photon.comm_stack.objstore else "shm"
-        return ParamTransport(mode, store=store)
+        return ParamTransport(mode, store=store, compression=cfg.photon.compression)
 
     def make_ckpt():
         from photon_tpu.checkpoint.client import ClientCheckpointManager

@@ -81,6 +81,13 @@ class ServerApp:
         self.ckpt_mgr = ckpt_mgr
         self.history = history or History()
         self.strategy = dispatch_strategy(cfg.fl)
+        if transport.codec is not None:
+            # compressed fit results flow to the strategy UNdecoded; the
+            # streaming aggregation dequantizes one client at a time through
+            # this hook (the codec's reference is pinned per round by
+            # broadcast_parameters)
+            self.strategy.payload_decoder = transport.codec.decode
+        self._wire_snapshot = transport.stats.snapshot()
         # fail fast on a typo'd per-round knob instead of shipping it to
         # every client each round (reference pydantic FitConfig validation,
         # ``clients/configs.py:55-214``)
@@ -180,6 +187,9 @@ class ServerApp:
             self.metadata,
             self.strategy.current_parameters,
         )
+        # the broadcast IS the round's delta base — pin it so compressed
+        # client results (w_new − w_global) decode against the right arrays
+        self.transport.set_reference(self.strategy.current_parameters)
         msg = Broadcast(server_round, ptr)
         acks = self.driver.broadcast(msg)
         bad = [nid for nid, a in acks.items() if not a.ok]
@@ -332,7 +342,9 @@ class ServerApp:
         def results() -> Iterator[ClientResult]:
             for res in self._sliding_window(server_round, cids, make_ins, timeout=self.cfg.fl.fit_timeout_s):
                 assert isinstance(res, FitRes)
-                _, arrays = self.transport.get(res.params)
+                # decode=False: compressed payloads stay compressed until the
+                # streaming aggregation folds them in, one client at a time
+                _, arrays = self.transport.get(res.params, decode=False)
                 if res.client_state:
                     self.client_states[res.cid] = res.client_state
                 g = res.metrics.get("client/pseudo_grad_norm")
@@ -353,6 +365,12 @@ class ServerApp:
         self.server_steps_cumulative += local_steps
         metrics["server/steps_cumulative"] = float(self.server_steps_cumulative)
         metrics["server/round_time"] = time.monotonic() - t_round
+        # bytes-on-wire: drain-since-last-fit semantics — every byte is
+        # counted exactly once (a post-fit eval broadcast lands in the NEXT
+        # round's numbers), so History.cumulative over the wire keys is the
+        # exact run total
+        metrics.update(self.transport.stats.metrics_since(self._wire_snapshot))
+        self._wire_snapshot = self.transport.stats.snapshot()
         return metrics
 
     def evaluate_round(self, server_round: int) -> dict[str, float]:

@@ -11,37 +11,105 @@ Here:
 A fourth, TPU-native path — aggregation as a cross-slice collective over
 DCN — lives in ``photon_tpu/parallel/collective_agg.py`` and bypasses
 pointers entirely (SURVEY.md §7 stage 6 "marquee feature").
+
+Wire compression (``photon_tpu/compression``): with a ``compression=``
+policy, :meth:`put` can encode a payload through the delta/top-k/int8 codec
+pipeline. The compressed bytes ride the SAME planes as a single uint8 blob;
+the pointer's ``metadata_json`` keeps the original (names, shapes, dtypes)
+contract and grows a back-compatible ``codec`` field describing the wire
+form. Bytes-on-wire accounting (raw vs. actual, both directions) accumulates
+in :attr:`stats` for the round metrics.
 """
 
 from __future__ import annotations
+
+import json
 
 import numpy as np
 
 from photon_tpu.checkpoint.store import ObjectStore
 from photon_tpu.checkpoint.serialization import arrays_to_npz, npz_to_arrays
 from photon_tpu.codec import ParamsMetadata
+from photon_tpu.compression import CompressedPayload, make_codec
 from photon_tpu.federation.messages import ParamPointer
 from photon_tpu.shm import plane as shm
+from photon_tpu.utils.profiling import WireStats
+
+#: reserved layer name carrying a serialized CompressedPayload through the
+#: planes (never collides with model paths, which are "/"-joined pytree keys)
+_BLOB_NAME = "__pcmp_blob__"
+
+
+def _blob_metadata(nbytes: int) -> ParamsMetadata:
+    return ParamsMetadata(
+        names=(_BLOB_NAME,), shapes=((nbytes,),), dtypes=("uint8",)
+    )
 
 
 class ParamTransport:
     """Writer/reader of parameter payloads behind pointers.
 
     ``mode`` selects the plane (reference: ``photon.comm_stack{s3,shm,ray}``
-    config, ``base_schema.py:11-28``).
+    config, ``base_schema.py:11-28``); ``compression`` a wire-codec policy
+    (a :class:`~photon_tpu.config.schema.CompressionConfig`, a policy
+    string, or an existing :class:`~photon_tpu.compression.Codec`).
     """
 
-    def __init__(self, mode: str = "shm", store: ObjectStore | None = None) -> None:
+    def __init__(
+        self,
+        mode: str = "shm",
+        store: ObjectStore | None = None,
+        compression=None,
+    ) -> None:
         if mode not in ("shm", "objstore", "inline"):
             raise ValueError(f"unknown transport mode {mode!r}")
         if mode == "objstore" and store is None:
             raise ValueError("objstore transport needs a store")
         self.mode = mode
         self.store = store
+        self.codec = make_codec(compression)
+        self.stats = WireStats()
         self._owned: list[str] = []  # shm segments we created (for cleanup)
+
+    # -- compression -----------------------------------------------------
+    def set_reference(self, arrays: list[np.ndarray] | None) -> None:
+        """Pin the round's global params as the codec's delta base (no-op
+        without a codec)."""
+        if self.codec is not None:
+            self.codec.set_reference(arrays)
 
     # -- write -----------------------------------------------------------
     def put(
+        self,
+        tag: str,
+        metadata: ParamsMetadata,
+        arrays: list[np.ndarray],
+        compress: bool = False,
+        key=None,
+    ) -> ParamPointer:
+        """Write a payload and return its pointer.
+
+        ``compress=True`` routes through the codec (when one is configured;
+        silently raw otherwise so policy "off" needs no call-site changes);
+        ``key`` names the error-feedback residual stream — the client id.
+        """
+        if compress and self.codec is not None:
+            payload = self.codec.encode(metadata, arrays, key=key)
+            blob = np.frombuffer(payload.to_bytes(), dtype=np.uint8)
+            self.stats.record_sent(metadata.total_bytes, blob.nbytes)
+            meta_d = json.loads(metadata.to_json())
+            meta_d["codec"] = {
+                "policy": payload.policy,
+                "version": payload.version,
+                "wire_nbytes": int(blob.nbytes),
+            }
+            ptr = self._put_raw(tag, _blob_metadata(blob.nbytes), [blob])
+            return ParamPointer(ptr.kind, ptr.locator, json.dumps(meta_d),
+                                inline=ptr.inline)
+        self.stats.record_sent(metadata.total_bytes, metadata.total_bytes)
+        return self._put_raw(tag, metadata, arrays)
+
+    def _put_raw(
         self, tag: str, metadata: ParamsMetadata, arrays: list[np.ndarray]
     ) -> ParamPointer:
         if self.mode == "shm":
@@ -58,9 +126,46 @@ class ParamTransport:
 
     # -- read ------------------------------------------------------------
     def get(
-        self, ptr: ParamPointer, copy: bool = True, timeout: float = 120.0
+        self,
+        ptr: ParamPointer,
+        copy: bool = True,
+        timeout: float = 120.0,
+        decode: bool = True,
+    ) -> tuple[ParamsMetadata, list[np.ndarray] | CompressedPayload]:
+        """Resolve a pointer to ``(metadata, arrays)``.
+
+        For codec-compressed pointers, ``decode=False`` returns
+        ``(metadata, CompressedPayload)`` instead — the streaming
+        aggregation path dequantizes one client at a time so only the
+        running average plus ONE decoded client is ever resident.
+        """
+        meta_d = json.loads(ptr.metadata_json)
+        metadata = ParamsMetadata.from_dict(meta_d)
+        codec_info = meta_d.get("codec")
+        if codec_info is None:
+            self.stats.record_recv(metadata.total_bytes, metadata.total_bytes)
+            return self._get_raw(ptr, metadata, copy=copy, timeout=timeout)
+        _, (blob,) = self._get_raw(
+            ptr, _blob_metadata(int(codec_info["wire_nbytes"])),
+            copy=False, timeout=timeout,
+        )
+        payload = CompressedPayload.from_bytes(bytes(blob))
+        self.stats.record_recv(metadata.total_bytes, payload.wire_nbytes)
+        if not decode:
+            return metadata, payload
+        if self.codec is None:
+            raise RuntimeError(
+                f"pointer {ptr.locator!r} carries a {codec_info['policy']} "
+                "payload but this transport has no codec — construct it with "
+                "the run's CompressionConfig"
+            )
+        arrays = self.codec.decode(payload)
+        metadata.validate_arrays(arrays)
+        return metadata, arrays
+
+    def _get_raw(
+        self, ptr: ParamPointer, metadata: ParamsMetadata, copy: bool, timeout: float
     ) -> tuple[ParamsMetadata, list[np.ndarray]]:
-        metadata = ParamsMetadata.from_json(ptr.metadata_json)
         if ptr.kind == "shm":
             shm.wait_for(ptr.locator, timeout=timeout)
             got_meta, arrays = shm.read_params(ptr.locator, copy=copy)

@@ -35,6 +35,12 @@ class History:
     def series(self, key: str) -> list[tuple[int, float]]:
         return list(self.rounds.get(key, []))
 
+    def cumulative(self, key: str) -> float:
+        """Sum of a per-round counter series — e.g. total bytes-on-wire over
+        a run (``server/wire_uplink_bytes`` vs ``server/wire_uplink_raw_bytes``
+        gives the run-level compression ratio)."""
+        return float(sum(v for _, v in self.rounds.get(key, [])))
+
     # -- checkpoint plumbing --------------------------------------------
     def to_dict(self) -> dict:
         return {k: list(v) for k, v in self.rounds.items()}

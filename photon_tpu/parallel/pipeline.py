@@ -249,13 +249,14 @@ def make_pipeline_train_step(
         g_others = jax.tree.map(lambda g: jax.lax.psum(g, "pipe"), g_others)
         return loss, g_blocks, g_others
 
-    pipelined = jax.shard_map(
+    from photon_tpu.parallel.context import partial_shard_map
+
+    pipelined = partial_shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P("pipe"), P(), P()),
         out_specs=(P(), P("pipe"), P()),
         axis_names={"pipe"},
-        check_vma=False,
     )
 
     def train_step(state: TrainState, tokens: jax.Array):

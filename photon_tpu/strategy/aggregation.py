@@ -13,30 +13,58 @@ to the sample-weighted mean but O(1) in memory w.r.t. client count.
 
 from __future__ import annotations
 
-from typing import Iterable, Iterator
+from typing import Callable, Iterable, Iterator
 
 import numpy as np
 
 
 def aggregate_inplace(
-    results: Iterable[tuple[list[np.ndarray], int]],
+    results: Iterable[tuple[object, int]],
+    decode: Callable[[object], list[np.ndarray]] | None = None,
 ) -> tuple[list[np.ndarray], int]:
     """Streaming sample-weighted mean over ``(arrays, n_samples)`` results.
 
     Returns (averaged arrays, total samples). The first result's arrays are
     copied (fp64 accumulate is deliberate — matches the reference's float
-    numpy accumulation and keeps the running rescale stable)."""
+    numpy accumulation and keeps the running rescale stable).
+
+    A result's first element may also be a compressed payload
+    (:class:`photon_tpu.compression.CompressedPayload`) when ``decode`` is
+    given: each payload is dequantized HERE, one client at a time, so memory
+    stays O(1) in client count — only the running average plus the single
+    client being folded in are ever resident."""
+
+    def _arrays(item) -> list[np.ndarray]:
+        if isinstance(item, (list, tuple)):
+            return list(item)
+        if decode is None:
+            raise TypeError(
+                f"aggregate_inplace got a {type(item).__name__} result but "
+                "no decode callback — pass decode= to consume compressed "
+                "payload streams"
+            )
+        return decode(item)
+
     it: Iterator = iter(results)
     try:
-        first_arrays, n_total = next(it)
+        first, n_total = next(it)
     except StopIteration:
         raise ValueError("aggregate_inplace: empty results") from None
     if n_total <= 0:
         raise ValueError(f"non-positive n_samples {n_total}")
-    acc = [np.asarray(a, dtype=np.float64) for a in first_arrays]
-    for arrays, n_cur in it:
+    acc = [np.asarray(a, dtype=np.float64) for a in _arrays(first)]
+    for item, n_cur in it:
         if n_cur <= 0:
             raise ValueError(f"non-positive n_samples {n_cur}")
+        arrays = _arrays(item)
+        if len(arrays) != len(acc):
+            # a shorter payload would fold PARTIALLY (acc tail never
+            # rescaled by w_prev for this client) — e.g. a momenta-extended
+            # checkpoint replayed into a momenta-less run
+            raise ValueError(
+                f"result has {len(arrays)} arrays, accumulator {len(acc)} "
+                "(momenta mismatch between payloads?)"
+            )
         n_new = n_total + n_cur
         w_prev = n_total / n_new
         w_cur = n_cur / n_new

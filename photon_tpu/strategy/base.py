@@ -25,10 +25,15 @@ from photon_tpu.strategy.aggregation import aggregate_inplace, weighted_average_
 
 @dataclasses.dataclass
 class ClientResult:
-    """One client's round output (the FitRes analog)."""
+    """One client's round output (the FitRes analog).
+
+    ``arrays`` is either the flat ndarray list or — when the wire codec is
+    on — a still-compressed
+    :class:`photon_tpu.compression.CompressedPayload`, dequantized lazily
+    inside the streaming aggregation (one client resident at a time)."""
 
     cid: int
-    arrays: list[np.ndarray]
+    arrays: list[np.ndarray]  # or a CompressedPayload (see above)
     n_samples: int
     metrics: dict[str, float] = dataclasses.field(default_factory=dict)
 
@@ -63,6 +68,9 @@ class Strategy:
         self.current_parameters: list[np.ndarray] | None = None
         self.state: dict[str, list[np.ndarray]] = {}
         self.server_round = 0
+        #: decoder for compressed ClientResult payloads (wired by ServerApp
+        #: when the transport carries a wire codec); None = raw arrays only
+        self.payload_decoder = None
 
     # ------------------------------------------------------------------
     def initialize(self, parameters: list[np.ndarray], state: dict[str, list[np.ndarray]] | None = None) -> None:
@@ -103,7 +111,7 @@ class Strategy:
                 seen.append((r.n_samples, r.metrics))
                 yield r.arrays, r.n_samples
 
-        avg, n_total = aggregate_inplace(stream())
+        avg, n_total = aggregate_inplace(stream(), decode=self.payload_decoder)
         metrics = self.apply_average(server_round, avg, n_total, len(seen))
         metrics.update(weighted_average_metrics(seen))
         return self.current_parameters, metrics

@@ -61,7 +61,12 @@ class Trainer:
         init_seed: int | None = None,
     ) -> None:
         self.cfg = cfg
-        self.model = MPTModel(cfg.model)
+        # mesh-driven attn_impl fallbacks (pipe→xla, sequence→ring) happen
+        # HERE, at step construction — never inside Config.validate(), so
+        # cfg.model stays the operator's config of record
+        from photon_tpu.config.schema import effective_model_config
+
+        self.model = MPTModel(effective_model_config(cfg.model, cfg.mesh))
         self.tx, self.lr_schedule = build_optimizer(cfg.optimizer, cfg.scheduler)
         self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
 

@@ -1,0 +1,49 @@
+"""Cross-version jax shims.
+
+The repo targets the jax_graft toolchain baked into the image; point releases
+move a few spellings around (virtual CPU device counts, shard_map's home).
+Every shim lives here so call sites stay on one idiom.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEVICE_FLAG = "--xla_force_host_platform_device_count"
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Request ``n`` virtual CPU devices before backend init.
+
+    Newer jax has the ``jax_num_cpu_devices`` config option; older releases
+    only honor the XLA host-platform flag, which must land in ``XLA_FLAGS``
+    before the CPU backend initializes. Call this (like the config update it
+    wraps) before first device use in the process.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+        return
+    except AttributeError:
+        pass  # old jax: fall through to the XLA flag
+    flags = [
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith(_DEVICE_FLAG)
+    ]
+    flags.append(f"{_DEVICE_FLAG}={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    # the config-option path raises RuntimeError when a backend is already
+    # up; the env-var fallback would just be silently ignored — preserve
+    # the loud failure callers (e.g. dryrun_multichip) rely on
+    try:
+        from jax._src import xla_bridge  # noqa: PLC2701 — no public probe exists
+
+        initialized = bool(getattr(xla_bridge, "_backends", None))
+    except ImportError:
+        initialized = False
+    if initialized:
+        raise RuntimeError(
+            f"set_cpu_device_count({n}): XLA_FLAGS fallback cannot take "
+            "effect — a jax backend is already initialized in this process"
+        )

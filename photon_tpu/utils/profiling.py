@@ -15,10 +15,60 @@ and photon's manual ``time.time_ns()`` spans. TPU equivalents:
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import time
 from typing import Iterator
 
 from photon_tpu.config.schema import ModelConfig
+
+
+@dataclasses.dataclass
+class WireStats:
+    """Bytes-on-wire accounting for the parameter plane.
+
+    ``raw`` is what the payload would cost uncompressed (its metadata's
+    ``total_bytes``), ``wire`` what actually moved; ``sent`` covers
+    :meth:`ParamTransport.put` (server: broadcasts; client: fit results),
+    ``recv`` covers :meth:`ParamTransport.get`. On the SERVER transport the
+    recv counters are therefore the uplink — the path the compression
+    subsystem exists for.
+    """
+
+    sent_raw_bytes: int = 0
+    sent_wire_bytes: int = 0
+    recv_raw_bytes: int = 0
+    recv_wire_bytes: int = 0
+    n_sent: int = 0
+    n_recv: int = 0
+
+    def record_sent(self, raw: int, wire: int) -> None:
+        self.sent_raw_bytes += int(raw)
+        self.sent_wire_bytes += int(wire)
+        self.n_sent += 1
+
+    def record_recv(self, raw: int, wire: int) -> None:
+        self.recv_raw_bytes += int(raw)
+        self.recv_wire_bytes += int(wire)
+        self.n_recv += 1
+
+    def snapshot(self) -> "WireStats":
+        return dataclasses.replace(self)
+
+    def metrics_since(self, prev: "WireStats", prefix: str = "server/") -> dict[str, float]:
+        """Round-delta metrics (recorded into History by the round loop):
+        uplink raw/wire bytes + compression ratio, downlink (broadcast)
+        wire bytes."""
+        up_raw = self.recv_raw_bytes - prev.recv_raw_bytes
+        up_wire = self.recv_wire_bytes - prev.recv_wire_bytes
+        down_wire = self.sent_wire_bytes - prev.sent_wire_bytes
+        out = {
+            f"{prefix}wire_uplink_raw_bytes": float(up_raw),
+            f"{prefix}wire_uplink_bytes": float(up_wire),
+            f"{prefix}wire_broadcast_bytes": float(down_wire),
+        }
+        if up_wire > 0:
+            out[f"{prefix}wire_compression_ratio"] = up_raw / up_wire
+        return out
 
 TPU_V5E_PEAK_FLOPS = 197e12  # bf16
 TPU_V4_PEAK_FLOPS = 275e12

@@ -160,12 +160,18 @@ def main() -> int:
             axes[k.strip()] = int(v)
     mesh_cfg = MeshConfig(**axes)
     cfg.mesh = mesh_cfg
-    cfg.validate()  # re-validate with the mesh (e.g. pallas→ring upgrade)
+    cfg.validate()
     mesh = make_mesh(mesh_cfg, devices=list(topo.devices))
 
-    model = MPTModel(cfg.model)
+    # mesh-driven attn_impl fallbacks (pipe→xla, sequence→ring) — same
+    # step-construction resolution the Trainer applies; validate() itself
+    # never mutates the config of record
+    from photon_tpu.config.schema import effective_model_config
+
+    model_cfg = effective_model_config(cfg.model, mesh_cfg)
+    model = MPTModel(model_cfg)
     tx, _ = build_optimizer(cfg.optimizer, cfg.scheduler)
-    params = jax.eval_shape(lambda: init_params(cfg.model, seed=0))
+    params = jax.eval_shape(lambda: init_params(model_cfg, seed=0))
     state = jax.eval_shape(lambda p: init_train_state(model, tx, p), params)
     shardings = state_shardings(state, mesh)
     state = jax.tree.map(

@@ -17,6 +17,19 @@ TEST_JAX_CACHE = os.environ.get("JAX_COMPILATION_CACHE_DIR") or str(
 )
 
 
+def _cache_safe() -> bool:
+    """Persistent-cache gate (see conftest): jax releases without
+    ``jax.shard_map`` (0.4.x) can deserialize donated-buffer executables
+    with broken input-output aliasing — a warm cache silently turns train
+    steps into no-ops there."""
+    import jax
+
+    return hasattr(jax, "shard_map")
+
+
+CACHE_SAFE = _cache_safe()
+
+
 def free_port() -> int:
     """Bind-port-0 trick for subprocess tests (TCP driver, jax.distributed)."""
     with socket.socket() as s:
@@ -36,8 +49,9 @@ def subprocess_env() -> dict:
     )
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["JAX_PLATFORMS"] = "cpu"
-    env["JAX_COMPILATION_CACHE_DIR"] = TEST_JAX_CACHE
-    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.5"
+    if CACHE_SAFE:
+        env["JAX_COMPILATION_CACHE_DIR"] = TEST_JAX_CACHE
+        env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.5"
     return env
 
 

@@ -1,0 +1,396 @@
+"""Wire-compression subsystem (``photon_tpu/compression``).
+
+Acceptance oracles (ISSUE 1): round-trip exactness for delta-only mode,
+bounded quantization error for q8, error-feedback residual accounting, and a
+small end-to-end federated run (inline transport) where delta+topk+q8
+aggregates to within 1e-2 of the uncompressed FedAvg result after 3 rounds
+while reporting ≥4× bytes-on-wire reduction on the uplink.
+"""
+
+import numpy as np
+import pytest
+
+from photon_tpu.codec import ParamsMetadata
+from photon_tpu.compression import (
+    Codec,
+    CompressedPayload,
+    decode_payload,
+    dequantize_q8,
+    make_codec,
+    quantize_q8,
+    topk_sparsify,
+)
+from photon_tpu.config.schema import CompressionConfig
+from photon_tpu.federation.transport import ParamTransport
+
+
+def _payload_fixture(seed=0, scale=0.02, delta_scale=1e-3):
+    rng = np.random.default_rng(seed)
+    arrays = [
+        rng.normal(0, scale, (64, 32)).astype(np.float32),
+        rng.normal(0, scale, (33,)).astype(np.float32),  # non-multiple of q8 block
+        rng.normal(0, scale, (7,)).astype(np.float32),  # smaller than any block
+    ]
+    ref = [a + rng.normal(0, delta_scale, a.shape).astype(np.float32) for a in arrays]
+    meta = ParamsMetadata.from_ndarrays(["w", "v", "b"], arrays)
+    return meta, arrays, ref
+
+
+# ---------------------------------------------------------------------------
+# stages
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_q8_bounded_error():
+    rng = np.random.default_rng(3)
+    x = rng.normal(0, 1.0, 10_000).astype(np.float32)
+    codes, scales = quantize_q8(x, block=256)
+    assert codes.dtype == np.int8 and scales.dtype == np.float32
+    back = dequantize_q8(codes, scales, block=256)
+    # per-block bound: absmax/254 (half the quantization step)
+    grid = np.zeros(-(-x.size // 256) * 256, np.float32)
+    grid[: x.size] = x
+    bounds = np.repeat(np.abs(grid.reshape(-1, 256)).max(axis=1) / 254, 256)[: x.size]
+    assert np.all(np.abs(x - back) <= bounds + 1e-7)
+
+
+def test_quantize_q8_zero_block_exact():
+    x = np.zeros(300, np.float32)
+    codes, scales = quantize_q8(x, block=256)
+    assert np.array_equal(dequantize_q8(codes, scales, block=256), x)
+
+
+def test_topk_keeps_largest_magnitudes():
+    x = np.array([0.1, -5.0, 0.01, 3.0, -0.2, 0.0, 2.0, -1.0], np.float64)
+    idx, vals = topk_sparsify(x, ratio=0.5)
+    assert list(idx) == [1, 3, 6, 7]  # sorted indices of the top-4 |x|
+    assert np.array_equal(vals, x[[1, 3, 6, 7]])
+
+
+# ---------------------------------------------------------------------------
+# codec policies
+# ---------------------------------------------------------------------------
+
+
+def test_delta_only_roundtrip_exact():
+    """The delta-only policy is lossless: float64 deltas of float32 arrays
+    reconstruct bit-for-bit."""
+    meta, arrays, ref = _payload_fixture()
+    codec = Codec("delta")
+    codec.set_reference(ref)
+    payload = codec.encode(meta, arrays, key=0)
+    assert payload.has_delta
+    out = codec.decode(payload)
+    for a, o in zip(arrays, out):
+        assert o.dtype == a.dtype
+        assert np.array_equal(a, o)
+    # and lossless means the EF residual is exactly zero
+    assert codec.ef.residual_norm(0) == 0.0
+
+
+def test_q8_policy_bounded_error():
+    meta, arrays, ref = _payload_fixture()
+    codec = Codec("delta_q8")
+    codec.set_reference(ref)
+    out = codec.decode(codec.encode(meta, arrays, key=None))
+    for a, r, o in zip(arrays, ref, out):
+        bound = np.abs(np.asarray(a, np.float64) - np.asarray(r, np.float64)).max() / 254
+        assert np.abs(np.asarray(a, np.float64) - o).max() <= bound + 1e-9
+
+
+def test_topk_q8_hits_4x_wire_reduction():
+    meta, arrays, ref = _payload_fixture()
+    codec = Codec("delta_topk_q8", topk_ratio=0.125)
+    codec.set_reference(ref)
+    payload = codec.encode(meta, arrays, key=None)
+    assert payload.raw_nbytes == meta.total_bytes
+    assert payload.compression_ratio >= 4.0
+
+
+def test_encode_without_reference_falls_back_to_values():
+    """No broadcast yet → has_delta=False, values encode against zero —
+    legal for dense policies, REFUSED for top-k (which would zero most of
+    the absolute weights silently)."""
+    meta, arrays, _ = _payload_fixture()
+    codec = Codec("delta")
+    payload = codec.encode(meta, arrays, key=None)
+    assert not payload.has_delta
+    out = decode_payload(payload, reference=None)
+    assert all(np.array_equal(a, o) for a, o in zip(arrays, out))
+
+    topk_codec = Codec("delta_topk_q8")
+    with pytest.raises(RuntimeError, match="delta reference"):
+        topk_codec.encode(meta, arrays, key=None)
+
+
+def test_error_feedback_lru_cap():
+    """One residual is a full fp32 model copy — the store is LRU-bounded."""
+    meta, arrays, ref = _payload_fixture()
+    codec = Codec("delta_q8", ef_max_clients=2)
+    codec.set_reference(ref)
+    for cid in (0, 1, 2):
+        codec.encode(meta, arrays, key=cid)
+    assert codec.ef.residual(0) is None  # evicted (least recently used)
+    assert codec.ef.residual(1) is not None and codec.ef.residual(2) is not None
+    # lossless policies never store residuals at all
+    lossless = Codec("delta")
+    lossless.set_reference(ref)
+    lossless.encode(meta, arrays, key=0)
+    assert lossless.ef.residual(0) is None
+
+
+def test_error_feedback_residual_accounting():
+    """residual_t = (delta_t + residual_{t-1}) − decode(encode(...)), per
+    layer — checked against a by-hand recomputation over two rounds."""
+    meta, arrays, ref = _payload_fixture(delta_scale=5e-3)
+    codec = Codec("delta_topk_q8", topk_ratio=0.25)
+    codec.set_reference(ref)
+
+    deltas = [(np.asarray(a, np.float64) - np.asarray(r, np.float64)).reshape(-1)
+              for a, r in zip(arrays, ref)]
+
+    def decoded_deltas(c, payload):
+        return [(np.asarray(o, np.float64) - np.asarray(r, np.float64)).reshape(-1)
+                for o, r in zip(c.decode(payload), ref)]
+
+    payload1 = codec.encode(meta, arrays, key=7)
+    decoded1 = decoded_deltas(codec, payload1)
+    res1 = codec.ef.residual(7)
+    for d, dec, r in zip(deltas, decoded1, res1):
+        np.testing.assert_allclose(r, d - dec, atol=1e-6)
+
+    # round 2, same raw delta: the encoder sees delta + residual
+    payload2 = codec.encode(meta, arrays, key=7)
+    decoded2 = decoded_deltas(codec, payload2)
+    res2 = codec.ef.residual(7)
+    for d, r1, dec2, r2 in zip(deltas, res1, decoded2, res2):
+        np.testing.assert_allclose(r2, (d + r1) - dec2, atol=1e-6)
+
+    # EF means the two rounds together deliver more of the true mass than
+    # two independent lossy encodes would: total decoded ≈ 2·delta + o(1)
+    err_with_ef = sum(
+        float(np.abs(2 * d - (a + b)).sum())
+        for d, a, b in zip(deltas, decoded1, decoded2)
+    )
+    codec_no_ef = Codec("delta_topk_q8", topk_ratio=0.25, error_feedback=False)
+    codec_no_ef.set_reference(ref)
+    dec_no_ef = decoded_deltas(codec_no_ef, codec_no_ef.encode(meta, arrays))
+    err_no_ef = sum(
+        float(np.abs(2 * d - 2 * a).sum()) for d, a in zip(deltas, dec_no_ef)
+    )
+    assert err_with_ef < err_no_ef
+
+
+def test_stale_residual_dropped_on_shape_change():
+    meta, arrays, ref = _payload_fixture()
+    codec = Codec("delta_q8")
+    codec.set_reference(ref)
+    codec.encode(meta, arrays, key=1)
+    assert codec.ef.residual(1) is not None
+    # same key, different payload layout (momenta toggled, say)
+    meta2 = ParamsMetadata.from_ndarrays(["w"], [arrays[0]])
+    codec.set_reference([ref[0]])
+    codec.encode(meta2, [arrays[0]], key=1)
+    assert len(codec.ef.residual(1)) == 1
+
+
+# ---------------------------------------------------------------------------
+# container
+# ---------------------------------------------------------------------------
+
+
+def test_payload_container_roundtrip_and_versioning():
+    meta, arrays, ref = _payload_fixture()
+    codec = Codec("delta_topk_q8", topk_ratio=0.25)
+    codec.set_reference(ref)
+    payload = codec.encode(meta, arrays, key=None)
+    data = payload.to_bytes()
+    back = CompressedPayload.from_bytes(data)
+    assert back.policy == payload.policy
+    assert back.has_delta == payload.has_delta
+    assert [b.name for b in back.layers] == [b.name for b in payload.layers]
+    assert all(np.array_equal(a, o)
+               for a, o in zip(codec.decode(payload), codec.decode(back)))
+    with pytest.raises(ValueError, match="magic"):
+        CompressedPayload.from_bytes(b"XXXX" + data[4:])
+    with pytest.raises(ValueError, match="version"):
+        CompressedPayload.from_bytes(data[:4] + b"\x63\x00" + data[6:])
+    with pytest.raises(ValueError, match="trailing"):
+        CompressedPayload.from_bytes(data + b"\x00")
+
+
+def test_make_codec_from_config():
+    assert make_codec(None) is None
+    assert make_codec("off") is None
+    assert make_codec(CompressionConfig()) is None  # default policy off
+    codec = make_codec(CompressionConfig(policy="delta_topk_q8", topk_ratio=0.5,
+                                         q8_block_size=128, error_feedback=False))
+    assert codec.policy == "delta_topk_q8"
+    assert codec.topk_ratio == 0.5 and codec.q8_block == 128 and codec.ef is None
+
+
+def test_compression_config_validated():
+    from photon_tpu.config.schema import Config
+
+    cfg = Config()
+    cfg.photon.compression.policy = "gzip"
+    with pytest.raises(ValueError, match="policy"):
+        cfg.validate()
+    cfg.photon.compression.policy = "delta_q8"
+    cfg.photon.compression.topk_ratio = 0.0
+    with pytest.raises(ValueError, match="topk_ratio"):
+        cfg.validate()
+    cfg.photon.compression.topk_ratio = 0.125
+    cfg.photon.compression.q8_block_size = 0
+    with pytest.raises(ValueError, match="q8_block_size"):
+        cfg.validate()
+
+
+# ---------------------------------------------------------------------------
+# transport integration
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["inline", "shm"])
+def test_transport_compressed_roundtrip(mode):
+    meta, arrays, ref = _payload_fixture()
+    tr = ParamTransport(mode, compression=CompressionConfig(policy="delta_topk_q8",
+                                                            topk_ratio=0.125))
+    try:
+        tr.set_reference(ref)
+        ptr = tr.put("cmp-test", meta, arrays, compress=True, key=3)
+        info = ptr.codec_info()
+        assert info is not None and info["policy"] == "delta_topk_q8"
+        # metadata_json keeps the ORIGINAL contract (back-compatible field)
+        assert ParamsMetadata.from_json(ptr.metadata_json).names == meta.names
+
+        got_meta, out = tr.get(ptr)
+        got_meta.validate_arrays(out)
+        for a, r, o in zip(arrays, ref, out):
+            assert np.abs(a - o).max() <= np.abs(a - r).max() + 1e-7
+
+        # decode=False hands back the still-compressed payload (the O(1)
+        # streaming-aggregation path)
+        _, payload = tr.get(ptr, decode=False)
+        assert isinstance(payload, CompressedPayload)
+        assert payload.compression_ratio >= 4.0
+        assert tr.stats.recv_wire_bytes < tr.stats.recv_raw_bytes / 4
+
+        # raw pointers still work through the same transport
+        raw_ptr = tr.put("raw-test", meta, arrays)
+        assert raw_ptr.codec_info() is None
+        _, raw_out = tr.get(raw_ptr)
+        assert all(np.array_equal(a, o) for a, o in zip(arrays, raw_out))
+    finally:
+        tr.cleanup()
+
+
+def test_transport_without_codec_rejects_compressed_pointer():
+    meta, arrays, ref = _payload_fixture()
+    sender = ParamTransport("inline", compression="delta_q8")
+    sender.set_reference(ref)
+    ptr = sender.put("x", meta, arrays, compress=True)
+    receiver = ParamTransport("inline")
+    with pytest.raises(RuntimeError, match="no codec"):
+        receiver.get(ptr)
+
+
+def test_aggregate_inplace_compressed_stream():
+    from photon_tpu.strategy.aggregation import aggregate_inplace
+
+    meta, arrays, ref = _payload_fixture()
+    codec = Codec("delta", error_feedback=False)  # lossless → exact equality
+    codec.set_reference(ref)
+    clients = [
+        ([a + 0.01 * i for a in arrays], 10 * (i + 1)) for i in range(3)
+    ]
+    plain = aggregate_inplace(iter(clients))
+    compressed = aggregate_inplace(
+        iter([(codec.encode(meta, [np.float32(a) for a in arrs]), n)
+              for arrs, n in clients]),
+        decode=codec.decode,
+    )
+    assert plain[1] == compressed[1]
+    for a, b in zip(plain[0], compressed[0]):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    # without a decode hook, a payload stream is a loud error
+    with pytest.raises(TypeError, match="decode"):
+        aggregate_inplace(iter([(codec.encode(meta, arrays), 1)]))
+    # a payload with a different array count must not fold partially
+    with pytest.raises(ValueError, match="accumulator"):
+        aggregate_inplace(iter([(arrays, 1), (arrays[:1], 1)]))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end federated parity (inline transport)
+# ---------------------------------------------------------------------------
+
+
+def _fed_cfg(tmp_path, policy):
+    from photon_tpu.config.schema import (
+        Config,
+        FLConfig,
+        MeshConfig,
+        ModelConfig,
+        OptimizerConfig,
+        PhotonConfig,
+        SchedulerConfig,
+        TrainConfig,
+    )
+
+    cfg = Config(
+        run_uuid="cmp-e2e",
+        model=ModelConfig(
+            d_model=32, n_layers=2, n_heads=2, max_seq_len=16, vocab_size=64,
+            attn_impl="xla", compute_dtype="float32",
+        ),
+        mesh=MeshConfig(),
+        optimizer=OptimizerConfig(name="adopt", lr=1e-3),
+        scheduler=SchedulerConfig(t_warmup=2, t_max=1000),
+        train=TrainConfig(global_batch_size=4, device_microbatch_size=4, eval_batches=2),
+        fl=FLConfig(
+            n_total_clients=4, n_clients_per_round=2, n_rounds=3, local_steps=2,
+            strategy_name="fedavg", server_learning_rate=1.0, sample_seed=99,
+        ),
+        photon=PhotonConfig(save_path=str(tmp_path / "save"), checkpoint=False),
+    )
+    cfg.dataset.synthetic = True
+    cfg.photon.compression.policy = policy
+    cfg.photon.compression.topk_ratio = 0.125
+    return cfg.validate()
+
+
+def _run_fed(cfg):
+    from photon_tpu.federation import InProcessDriver, NodeAgent, ServerApp
+
+    comp = cfg.photon.compression
+    transport = ParamTransport("inline", compression=comp)
+
+    def make_agent(node_id):
+        return NodeAgent(cfg, node_id,
+                         lambda: ParamTransport("inline", compression=comp))
+
+    driver = InProcessDriver(cfg, make_agent, n_nodes=2)
+    app = ServerApp(cfg, driver, transport)
+    history = app.run()
+    params = [a.copy() for a in app.strategy.current_parameters]
+    app.driver.shutdown()
+    return params, history
+
+
+def test_e2e_compressed_fedavg_matches_uncompressed(tmp_path):
+    """delta+topk+q8 with error feedback stays within 1e-2 of the
+    uncompressed FedAvg parameters after 3 rounds, at ≥4× less uplink."""
+    p_raw, _ = _run_fed(_fed_cfg(tmp_path / "raw", "off"))
+    p_cmp, hist = _run_fed(_fed_cfg(tmp_path / "cmp", "delta_topk_q8"))
+
+    diff = max(float(np.abs(a - b).max()) for a, b in zip(p_raw, p_cmp))
+    assert diff < 1e-2, f"compressed run diverged: max param diff {diff}"
+
+    ratio = hist.latest("server/wire_compression_ratio")
+    assert ratio is not None and ratio >= 4.0, f"uplink ratio {ratio}"
+    assert len(hist.series("server/wire_uplink_bytes")) == 3
+    # run-level accounting via the History counter helper
+    assert hist.cumulative("server/wire_uplink_bytes") * 4 <= hist.cumulative(
+        "server/wire_uplink_raw_bytes"
+    )

@@ -14,6 +14,16 @@ import optax
 import pytest
 from jax.sharding import NamedSharding
 
+# the pipeline's partial-manual shard_map (manual over `pipe` only) needs
+# the jax.shard_map era of partial-manual lowering; the older
+# experimental-shard_map + auto-axes spelling hits an XLA "PartitionId is
+# not supported for SPMD partitioning" abort on EVERY pipe mesh. Equivalence
+# tests only run where the capability exists; validation tests always run.
+_PARTIAL_MANUAL = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="partial-manual shard_map unsupported on this jax/XLA",
+)
+
 from photon_tpu.config.schema import Config, MeshConfig
 from photon_tpu.models.mpt import MPTModel, init_params
 from photon_tpu.parallel.mesh import make_mesh
@@ -76,11 +86,13 @@ def _reference_grads(cfg, params, tokens, n_micro, chunk):
     return jax.grad(loss)(params), float(loss(params))
 
 
+@_PARTIAL_MANUAL
 @pytest.mark.parametrize(
     "mesh,chunk",
     [
         (MeshConfig(data=2, pipe=4), 2048),  # pipe x data, chunked CE
         (MeshConfig(pipe=2, fsdp=2), 2048),  # pipe x fsdp (auto inside)
+        (MeshConfig(tensor=2, pipe=2), 2048),  # pipe x tensor (TP inside stages)
         (MeshConfig(data=2, pipe=4), 0),     # unchunked tail path
     ],
 )
@@ -96,6 +108,7 @@ def test_pipeline_matches_reference_grads(mesh, chunk):
     )
 
 
+@_PARTIAL_MANUAL
 def test_pipeline_matches_with_remat_and_llama_family():
     """Remat inside stages + the llama knobs (RoPE/RMSNorm/SwiGLU/GQA)
     flow through MPTBlock reuse unchanged."""
@@ -114,6 +127,7 @@ def test_pipeline_matches_with_remat_and_llama_family():
     )
 
 
+@_PARTIAL_MANUAL
 def test_pipeline_matches_with_moe():
     """MoE stages through the pipeline: the per-layer Switch aux losses
     are collected through the stage scan (bubble ticks excluded) and the
@@ -145,11 +159,27 @@ def test_pipeline_validation():
         # expert is a batch axis too (batch_spec)
         _cfg(MeshConfig(data=2, expert=2, pipe=2),
              mlp="moe", moe_num_experts=4)
+    # pallas under pipe is legal at validation time and NOT mutated: a
+    # config serialized after validate() must match the operator's input.
+    # The xla fallback happens at Trainer construction (next test).
+    cfg = _cfg(MeshConfig(pipe=2), attn_impl="pallas")
+    assert cfg.model.attn_impl == "pallas"
+
+
+def test_trainer_defers_pallas_pipe_fallback():
+    """The pallas→xla fallback under pipe>1 lives at step construction:
+    the Trainer's model runs xla attention inside stages while the config
+    of record keeps the operator's attn_impl."""
+    from photon_tpu.train.trainer import Trainer
+
+    cfg = _cfg(MeshConfig(data=2, pipe=2), attn_impl="pallas")
     with pytest.warns(UserWarning, match="falling back to"):
-        cfg = _cfg(MeshConfig(pipe=2), attn_impl="pallas")
-    assert cfg.model.attn_impl == "xla"
+        trainer = Trainer(cfg, mesh=make_mesh(cfg.mesh), init_seed=0)
+    assert cfg.model.attn_impl == "pallas"  # untouched config of record
+    assert trainer.model.cfg.attn_impl == "xla"
 
 
+@_PARTIAL_MANUAL
 def test_trainer_runs_pipelined():
     """Trainer picks the pipeline step for pipe>1 meshes; loss falls on a
     repeated batch and the state layout (checkpoint format) is unchanged."""
