@@ -1,7 +1,7 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test test-all bench native clean
+.PHONY: test test-all bench bench-host native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
@@ -16,6 +16,11 @@ test-all:
 bench:
 	-$(MAKE) native
 	python bench.py
+
+# host-plane aggregation report only (serial vs pipelined fold+decode);
+# CPU-runnable, no relay/TPU claim
+bench-host:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --host-plane
 
 native: native/libphoton_native.so
 

@@ -1250,6 +1250,143 @@ def wire_compression_report(model_cfg, budget_bytes: int = 64 << 20) -> dict | N
 
 
 # ---------------------------------------------------------------------------
+# Host-plane aggregation pipeline (host-side; lands in the BENCH_*.json schema)
+# ---------------------------------------------------------------------------
+
+
+def host_plane_report(model_cfg=None, n_clients: int = 8,
+                      budget_bytes: int | None = None,
+                      threads: int = 0, repeats: int = 2) -> dict | None:
+    """Serial vs pipelined host aggregation throughput (ISSUE 2 tentpole).
+
+    Pure host/numpy work, CPU-runnable on a dead relay. The payload is
+    125M-SHAPED: real layer shapes from an abstract ``init_params``
+    eval_shape, subset deterministically up to ``budget_bytes``
+    (PHOTON_BENCH_HOST_BYTES, default 64 MiB) so the report doesn't cost
+    8 × 0.5 GB of RAM; ``raw_bytes_full_model`` keeps the full-payload
+    provenance. One synthetic client payload is folded ``n_clients`` times
+    with distinct weights (fold/decode cost is value-independent), through
+    two paths:
+
+    - ``raw``: fused chunked fold only (serial HostPool(1) vs pipelined);
+    - ``compressed``: a ``delta_topk_q8`` payload stream — per-layer
+      dequantize + decode-ahead + fold.
+
+    Each timing is the best of ``repeats``; ``bit_exact`` asserts the
+    pipelined result is byte-identical to the serial one. ``cpu_count`` /
+    ``threads`` provenance lands in the report."""
+    try:
+        import numpy as np
+
+        from photon_tpu.codec import ParamsMetadata
+        from photon_tpu.compression import Codec
+        from photon_tpu.strategy.aggregation import aggregate_inplace
+        from photon_tpu.utils.hostpool import HostPool, resolve_host_threads
+
+        if budget_bytes is None:
+            budget_bytes = int(os.environ.get("PHOTON_BENCH_HOST_BYTES",
+                                              64 << 20))
+        if model_cfg is None:
+            from photon_tpu.config.schema import ModelConfig
+
+            model_cfg = ModelConfig()  # the 125M recipe shape
+        import jax
+
+        from photon_tpu.codec import flatten_params
+        from photon_tpu.models.mpt import init_params
+
+        abstract = jax.eval_shape(lambda: init_params(model_cfg, seed=0))
+        names, leaves = flatten_params(abstract)
+        shapes = [tuple(l.shape) for l in leaves]
+        raw_full = sum(int(np.prod(s, dtype=np.int64)) * 4 for s in shapes)
+
+        rng = np.random.default_rng(0)
+        # MANY-layer subset: skip any layer that would blow the budget (the
+        # vocab embedding alone is ~150 MB — taking it would leave a
+        # 1-layer payload with nothing for per-layer parallelism to chew
+        # on); the transformer-block layers that remain are exactly the
+        # shapes the per-array fold and per-layer decode parallelize over
+        sample_names, arrays, sampled = [], [], 0
+        for name, shape in zip(names, shapes):
+            nbytes = int(np.prod(shape, dtype=np.int64)) * 4
+            if sampled + nbytes > budget_bytes:
+                continue
+            sample_names.append(name)
+            arrays.append(rng.normal(0, 0.02, shape).astype(np.float32))
+            sampled += nbytes
+        if not arrays:  # budget below even the smallest layer: take it anyway
+            i = int(np.argmin([np.prod(s, dtype=np.int64) for s in shapes]))
+            sample_names = [names[i]]
+            arrays = [rng.normal(0, 0.02, shapes[i]).astype(np.float32)]
+            sampled = int(np.prod(shapes[i], dtype=np.int64)) * 4
+        meta = ParamsMetadata.from_ndarrays(sample_names, arrays)
+        ref = [a + rng.normal(0, 1e-3, a.shape).astype(np.float32)
+               for a in arrays]
+        weights = list(rng.integers(64, 512, n_clients))
+
+        codec = Codec("delta_topk_q8", topk_ratio=0.125, error_feedback=False)
+        codec.set_reference(ref)
+        payload = codec.encode(meta, arrays)
+
+        n_threads = resolve_host_threads(threads)
+        serial_pool = HostPool(1)
+        pipe_pool = HostPool(n_threads)
+
+        def run_once(pool, compressed: bool):
+            if compressed:
+                stream = ((payload, int(w)) for w in weights)
+                dec = (lambda p: codec.decode(p, pool=pool)) if pool.pipelined \
+                    else codec.decode
+            else:
+                stream = ((arrays, int(w)) for w in weights)
+                dec = None
+            t0 = time.perf_counter()
+            out, _ = aggregate_inplace(stream, decode=dec, pool=pool)
+            return time.perf_counter() - t0, out
+
+        report: dict = {
+            "cpu_count": os.cpu_count(),
+            "threads": n_threads,
+            "n_clients": n_clients,
+            "payload_bytes_per_client": sampled,
+            "raw_bytes_full_model": raw_full,
+            "n_layers_sampled": len(arrays),
+            "policy": "delta_topk_q8",
+        }
+        total_raw = sampled * n_clients
+        for kind, compressed in (("raw", False), ("compressed", True)):
+            t_serial, out_serial = min(
+                (run_once(serial_pool, compressed) for _ in range(repeats)),
+                key=lambda r: r[0],
+            )
+            if pipe_pool.pipelined:
+                best_pipe, out_pipe = min(
+                    (run_once(pipe_pool, compressed) for _ in range(repeats)),
+                    key=lambda r: r[0],
+                )
+            else:
+                # <2 workers resolved (see resolve_host_threads): the
+                # pipelined path IS the serial path — reuse the measurement
+                # instead of re-timing identical code into noise
+                best_pipe, out_pipe = t_serial, out_serial
+            report[kind] = {
+                "serial_s": round(t_serial, 4),
+                "pipelined_s": round(best_pipe, 4),
+                "serial_gb_s": round(total_raw / t_serial / 1e9, 3),
+                "pipelined_gb_s": round(total_raw / best_pipe / 1e9, 3),
+                "speedup": round(t_serial / max(best_pipe, 1e-9), 2),
+                "bit_exact": all(
+                    np.array_equal(a, b) for a, b in zip(out_serial, out_pipe)
+                ),
+            }
+        pipe_pool.close()
+        return report
+    except Exception as e:  # noqa: BLE001 — never cost the round its numbers
+        log(f"host plane report failed: {type(e).__name__}: {e}")
+        return None
+
+
+# ---------------------------------------------------------------------------
 # The actual bench (child process)
 # ---------------------------------------------------------------------------
 
@@ -1571,6 +1708,15 @@ def run(platform: str) -> None:
             out["wire_compression"] = wc
             emit(out)
 
+    # host-plane aggregation pipeline (host-side, no device time): serial vs
+    # pipelined fold+decode throughput on the 125M-shaped payload, so the
+    # BENCH trajectory carries a host-plane number even on a dead relay
+    if os.environ.get("PHOTON_BENCH_SKIP_HOST_PLANE") != "1":
+        hp = host_plane_report(cfg.model)
+        if hp is not None:
+            out["host_plane"] = hp
+            emit(out)
+
     # under the supervisor (PHOTON_BENCH_ORCHESTRATED) parity and the
     # evidence stages run in their own child processes with fresh relay
     # claims; inline execution remains for manual `--run` invocations
@@ -1688,9 +1834,18 @@ def main() -> int:
     ap.add_argument("--platform", default="tpu", choices=["tpu", "cpu"])
     ap.add_argument("--kernel-parity", action="store_true",
                     help="run only the Pallas-vs-XLA parity check and print its JSON")
+    ap.add_argument("--host-plane", action="store_true",
+                    help="run only the host-plane aggregation report (CPU, "
+                         "no device) and print {'host_plane': ...}")
     ap.add_argument("--stage", choices=["parity", "conv", "gauntlet", "1b"],
                     help="run ONE parity/evidence stage in-process (own relay claim)")
     args = ap.parse_args()
+    if args.host_plane:
+        # pure host work — pin jax to CPU so the report runs on a dead relay
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        hp = host_plane_report()
+        emit({"host_plane": hp})
+        return 0 if hp is not None else 1
     if args.kernel_parity:
         parity = kernel_parity(full=True, sink=_parity_sink)
         emit(parity)

@@ -16,6 +16,8 @@ Reference semantics (``photon/server/s3_utils.py``):
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Any
 
 import numpy as np
@@ -37,6 +39,85 @@ class ServerCheckpointManager:
     def __init__(self, store: ObjectStore, run_uuid: str) -> None:
         self.store = store
         self.run_uuid = run_uuid
+        # async round writer (PR 2): at most ONE background write in flight;
+        # save/resume/load barrier on it so readers never race a writer
+        self._pending: threading.Thread | None = None
+        self._pending_error: BaseException | None = None
+        self._last_async_write_s = 0.0
+        self._last_barrier_wait_s = 0.0
+
+    # -- async writer ----------------------------------------------------
+    @property
+    def last_async_write_s(self) -> float:
+        """Duration of the most recently COMPLETED background write (0.0
+        until one completes — round N's metrics see round N-1's write)."""
+        return self._last_async_write_s
+
+    @property
+    def last_barrier_wait_s(self) -> float:
+        """How long the latest :meth:`save_round_async` blocked on the
+        PREVIOUS round's write (0.0 when the store is faster than a round;
+        grows exactly when async checkpointing stops hiding the write)."""
+        return self._last_barrier_wait_s
+
+    def wait_pending(self) -> None:
+        """Barrier: join any in-flight background write; re-raise its error
+        (a silently dropped checkpoint failure would surface only at a
+        much later resume)."""
+        th = self._pending
+        if th is not None:
+            th.join()
+            self._pending = None
+        err, self._pending_error = self._pending_error, None
+        if err is not None:
+            raise RuntimeError("async checkpoint write failed") from err
+
+    def save_round_async(
+        self,
+        server_round: int,
+        metadata: ParamsMetadata,
+        parameters: list[np.ndarray],
+        strategy_state: dict[str, list[np.ndarray]] | None = None,
+        server_state: dict[str, Any] | None = None,
+        cleanup_keep: tuple[int, tuple[str, ...]] | None = None,
+    ) -> float:
+        """Snapshot + enqueue a :meth:`save_round` on a background writer;
+        returns the (cheap) snapshot/enqueue seconds.
+
+        The barrier with any previous in-flight write runs FIRST, so writes
+        stay ordered and at most one round's write is ever outstanding. The
+        snapshot is shallow — list/dict containers are copied, array objects
+        are not: the strategies rebind list slots with fresh arrays each
+        round and never mutate an ndarray in place, so the captured arrays
+        are immutable from the writer's point of view. ``cleanup_keep``
+        (``(keep, state_keys)``) runs the GC on the writer thread after the
+        round lands."""
+        t_barrier = time.monotonic()
+        self.wait_pending()
+        self._last_barrier_wait_s = time.monotonic() - t_barrier
+        params = list(parameters)
+        state = {k: list(v) for k, v in (strategy_state or {}).items()}
+        server = dict(server_state or {})
+        t_enqueue = time.monotonic()
+
+        def _write() -> None:
+            t0 = time.monotonic()
+            try:
+                self.save_round(server_round, metadata, params, state, server)
+                if cleanup_keep is not None:
+                    keep, keys = cleanup_keep
+                    self.cleanup(keep, keys)
+            except BaseException as e:  # noqa: BLE001 — re-raised at the barrier
+                self._pending_error = e
+            finally:
+                self._last_async_write_s = time.monotonic() - t0
+
+        th = threading.Thread(
+            target=_write, name=f"ckpt-write-r{server_round}", daemon=True
+        )
+        self._pending = th
+        th.start()
+        return time.monotonic() - t_enqueue
 
     # -- keys ------------------------------------------------------------
     def _round_prefix(self, server_round: int, run_uuid: str | None = None) -> str:
@@ -96,6 +177,7 @@ class ServerCheckpointManager:
         """Non-negative → that round (validated). Negative → index from the
         latest valid round: −1 = latest, −2 = one before, ... (reference:
         ``s3_utils.py:1261-1318``)."""
+        self.wait_pending()  # resume must see every completed async write
         valid = self.valid_rounds(state_keys)
         if not valid:
             raise FileNotFoundError(f"no valid checkpoints for run {self.run_uuid!r}")
@@ -113,6 +195,7 @@ class ServerCheckpointManager:
     def load_round(
         self, server_round: int, state_keys: tuple[str, ...] = ()
     ) -> tuple[ParamsMetadata, list[np.ndarray], dict[str, list[np.ndarray]], dict[str, Any]]:
+        self.wait_pending()  # never read a round a writer may still be landing
         prefix = self._round_prefix(server_round)
         metadata, parameters = npz_to_arrays(self.store.get(f"{prefix}/{PARAMS_FILE}"))
         strategy_state: dict[str, list[np.ndarray]] = {}

@@ -22,9 +22,12 @@ travels with the payload.
 
 from __future__ import annotations
 
-from typing import Any, Hashable
+from typing import TYPE_CHECKING, Any, Hashable
 
 import numpy as np
+
+if TYPE_CHECKING:  # annotation only — compression stays importable standalone
+    from photon_tpu.utils.hostpool import HostPool
 
 from photon_tpu.compression.delta import decode_delta, encode_delta
 from photon_tpu.compression.error_feedback import ErrorFeedback
@@ -104,16 +107,21 @@ class Codec:
 
     # -- encode ----------------------------------------------------------
     def encode(self, metadata, arrays: list[np.ndarray],
-               key: Hashable | None = None) -> CompressedPayload:
+               key: Hashable | None = None,
+               pool: "HostPool | None" = None) -> CompressedPayload:
         """(metadata, arrays) → :class:`CompressedPayload`.
 
         ``key`` identifies the error-feedback residual stream (the client
         id); None disables residual accounting for this payload.
 
-        Layers stream one at a time: each float layer's float64 delta is
-        compensated, encoded, locally round-tripped for its residual, and
-        released before the next — the peak fp64 working set is ONE layer,
-        not a second full model copy.
+        Each float layer's float64 delta is compensated, encoded, locally
+        round-tripped for its residual, and released: serially the peak
+        fp64 working set is ONE layer, not a second full model copy. With
+        ``pool`` (a :class:`~photon_tpu.utils.hostpool.HostPool`) layers
+        encode in parallel — the peak working set grows to at most
+        ``pool.threads`` layers, still far below a model copy, and the
+        layer/residual ORDER of the result is identical to the serial path
+        (ordered map), so the wire bytes don't depend on threading.
         """
         metadata.validate_arrays(arrays)
         ref = self._matching_reference(arrays) if self.delta else None
@@ -141,34 +149,39 @@ class Codec:
                 [int(np.prod(s, dtype=np.int64))
                  for s, f in zip(metadata.shapes, is_float) if f],
             )
-        new_res: list[np.ndarray] = []
-        j = 0  # float-layer index into the residual lists
+        # float-layer index per layer (residual streams cover float layers only)
+        j_of: list[int] = []
+        j = 0
+        for f in is_float:
+            j_of.append(j)
+            if f:
+                j += 1
 
-        for i, (name, shape, dtype) in enumerate(
-            zip(metadata.names, metadata.shapes, metadata.dtypes)
-        ):
+        def _encode_layer(i: int) -> tuple[LayerBlock, np.ndarray | None]:
+            name, shape, dtype = metadata.names[i], metadata.shapes[i], metadata.dtypes[i]
             if not is_float[i]:
                 # non-float passthrough: raw bytes, no delta/quant
-                payload.layers.append(LayerBlock(
+                return LayerBlock(
                     name=name, shape=tuple(shape), dtype=dtype,
                     encoding="raw", quant="none",
                     segments={"raw": np.ascontiguousarray(arrays[i]).reshape(-1)},
-                ))
-                continue
+                ), None
             delta = encode_delta(arrays[i], ref[i] if ref is not None else None)
             if old_res is not None:
-                delta = delta + old_res[j].astype(np.float64)
+                delta = delta + old_res[j_of[i]].astype(np.float64)
             block = self._encode_float_layer(name, tuple(shape), dtype, delta)
-            payload.layers.append(block)
+            res = None
             if track_ef:
-                new_res.append(
-                    (delta - self._decode_float_layer(block)).astype(np.float32)
-                )
-            j += 1
-            del delta
+                res = (delta - self._decode_float_layer(block)).astype(np.float32)
+            return block, res
 
+        if pool is not None and pool.pipelined:
+            encoded = pool.map(_encode_layer, range(len(arrays)))
+        else:
+            encoded = [_encode_layer(i) for i in range(len(arrays))]
+        payload.layers.extend(block for block, _ in encoded)
         if track_ef:
-            self.ef.store(key, new_res)
+            self.ef.store(key, [r for _, r in encoded if r is not None])
         return payload
 
     def _encode_float_layer(self, name: str, shape: tuple[int, ...], dtype: str,
@@ -199,9 +212,13 @@ class Codec:
         )
 
     # -- decode ----------------------------------------------------------
-    def decode(self, payload: CompressedPayload) -> list[np.ndarray]:
+    def decode(self, payload: CompressedPayload,
+               pool: "HostPool | None" = None) -> list[np.ndarray]:
         """Payload → full arrays, one layer at a time (the aggregation path
-        calls this per client, so at most one dense decode is live)."""
+        calls this per client, so at most one dense decode is live). With
+        ``pool``, layers dequantize in parallel (all reads: the reference
+        and the payload's wire segments are never mutated); the output
+        order matches the serial path exactly."""
         ref = self._reference
         if payload.has_delta:
             if ref is None:
@@ -213,15 +230,18 @@ class Codec:
                 raise ValueError(
                     f"reference has {len(ref)} arrays, payload {len(payload.layers)}"
                 )
-        out: list[np.ndarray] = []
-        for i, block in enumerate(payload.layers):
+
+        def _decode_layer(i: int) -> np.ndarray:
+            block = payload.layers[i]
             if block.encoding == "raw":
-                out.append(block.segments["raw"].reshape(block.shape).copy())
-                continue
+                return block.segments["raw"].reshape(block.shape).copy()
             dense = self._decode_float_layer(block)
             r = ref[i] if payload.has_delta else None
-            out.append(decode_delta(dense, r, block.shape, block.dtype))
-        return out
+            return decode_delta(dense, r, block.shape, block.dtype)
+
+        if pool is not None and pool.pipelined:
+            return pool.map(_decode_layer, range(len(payload.layers)))
+        return [_decode_layer(i) for i in range(len(payload.layers))]
 
     def _decode_float_layer(self, block: LayerBlock) -> np.ndarray:
         """One layer's flat float64 dense delta from its wire segments."""

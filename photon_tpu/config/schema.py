@@ -281,8 +281,19 @@ class PhotonConfig:
 
     n_nodes: int = 1
     refresh_period: int = 0  # restart executors every N rounds; 0 = never
+    # host-plane round pipeline (utils/hostpool.py): worker threads shared
+    # by the codec's per-layer encode/decode, the per-array aggregation
+    # fold, and the one-client decode-ahead. 0 = auto (min(cpu_count−1, 8)
+    # — the driving thread is itself a pipeline stage), 1 = fully serial
+    # (the degenerate config — inline, zero threads).
+    # Results are bit-identical across settings; only wall-clock moves.
+    host_threads: int = 0
     checkpoint: bool = True
     checkpoint_interval: int = 1
+    # write round checkpoints on a background thread so round N+1's
+    # broadcast/fits overlap round N's disk write (barrier at the next
+    # save/resume/shutdown keeps crash-resume consistency)
+    async_checkpoint: bool = True
     keep_checkpoints: int = 3
     resume_round: int | None = None  # negative = index from latest valid
     restore_run_uuid: str | None = None
@@ -429,6 +440,11 @@ class Config:
             raise ValueError("n_kv_heads and mlp_hidden_size must be >= 0")
         if self.model.n_kv_heads and self.model.n_heads % self.model.n_kv_heads:
             raise ValueError("n_heads must be a multiple of n_kv_heads")
+        if self.photon.host_threads < 0:
+            raise ValueError(
+                f"photon.host_threads must be >= 0 (0 = auto), got "
+                f"{self.photon.host_threads}"
+            )
         comp = self.photon.compression
         from photon_tpu.compression import policy_flags
 

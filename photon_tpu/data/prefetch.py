@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterator
 
 import numpy as np
@@ -34,15 +35,26 @@ class PrefetchIterator:
         self._thread = threading.Thread(target=self._fill, daemon=True)
         self._thread.start()
 
+    def _put(self, item) -> bool:
+        """Stop-aware put: never blocks past a close(). A plain
+        ``Queue.put`` deadlocks when the consumer is gone — the exact
+        drain race ``close()`` used to lose (see below)."""
+        while not self._stopped:
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _fill(self) -> None:
         try:
             for batch in self.source:
-                if self._stopped:
+                if self._stopped or not self._put(batch):
                     return
-                self._q.put(batch)
         except BaseException as e:  # noqa: BLE001 — surfaced on the consumer side
             self._err = e
-        self._q.put(self._DONE)
+        self._put(self._DONE)
 
     def __iter__(self) -> Iterator[np.ndarray]:
         return self
@@ -55,11 +67,23 @@ class PrefetchIterator:
             raise StopIteration
         return item
 
-    def close(self) -> None:
+    def close(self, timeout: float = 2.0) -> None:
+        """Stop and JOIN the producer (with timeout).
+
+        The old single-drain close lost a race: the producer could refill
+        the queue after the drain and then block forever — in particular
+        the ``put(_DONE)`` after source exhaustion had no stop check at
+        all, leaking a permanently blocked thread. Now the producer's puts
+        are stop-aware, and close keeps draining until the thread exits so
+        any in-flight put is released."""
         self._stopped = True
-        # drain so the producer unblocks if waiting on a full queue
-        try:
-            while True:
-                self._q.get_nowait()
-        except queue.Empty:
-            pass
+        deadline = time.monotonic() + timeout
+        while self._thread.is_alive():
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+            if time.monotonic() >= deadline:
+                break

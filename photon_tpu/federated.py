@@ -89,14 +89,16 @@ def build_app(
                 cfg,
                 node_id,
                 make_transport=lambda: ParamTransport(
-                    mode, store=store, compression=cfg.photon.compression
+                    mode, store=store, compression=cfg.photon.compression,
+                    host_threads=cfg.photon.host_threads,
                 ),
                 make_ckpt_mgr=lambda: ClientCheckpointManager(store, cfg.run_uuid),
             )
 
         driver = InProcessDriver(cfg, make_agent, n_nodes=n_nodes)
 
-    transport = ParamTransport(mode, store=store, compression=cfg.photon.compression)
+    transport = ParamTransport(mode, store=store, compression=cfg.photon.compression,
+                               host_threads=cfg.photon.host_threads)
     ckpt = ServerCheckpointManager(store, cfg.run_uuid) if cfg.photon.checkpoint else None
     from photon_tpu.metrics.history import History
 

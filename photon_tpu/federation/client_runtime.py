@@ -196,6 +196,7 @@ class ClientRuntime:
         else:
             base_meta, params_in, m1_in, m2_in = meta, list(arrays), None, None
 
+        params_touched = bool(knobs.personalize_patterns or knobs.randomize_patterns)
         if knobs.personalize_patterns:
             params_in = personalize_layers(
                 base_meta, params_in, self._personal.get(cid), knobs.personalize_patterns
@@ -207,7 +208,13 @@ class ClientRuntime:
             )
 
         self.trainer.set_parameters(base_meta, params_in)
-        initial = [a.copy() for a in params_in]
+        # ``initial`` exists only to difference the pseudo-grad norm below.
+        # When no personalize/randomize knob touched the params, params_in
+        # still aliases the cached broadcast arrays — which nothing mutates
+        # (set_parameters device_puts; fit returns FRESH host arrays) — so
+        # the ~full-model copy (~500 MB/client/round at 125M) is skipped
+        # and the norm is computed against the held broadcast reference.
+        initial = [a.copy() for a in params_in] if params_touched else params_in
 
         # reset knobs (reference: ``load_ignore_keys`` globs, ``clients/utils.py:219-249``)
         if knobs.reset_optimizer:

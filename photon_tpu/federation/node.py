@@ -128,7 +128,8 @@ def node_process_main(cfg_json: str, node_id: str, conn, platform: str | None, n
 
     def make_transport() -> ParamTransport:
         mode = "objstore" if cfg.photon.comm_stack.objstore else "shm"
-        return ParamTransport(mode, store=store, compression=cfg.photon.compression)
+        return ParamTransport(mode, store=store, compression=cfg.photon.compression,
+                              host_threads=cfg.photon.host_threads)
 
     def make_ckpt():
         from photon_tpu.checkpoint.client import ClientCheckpointManager

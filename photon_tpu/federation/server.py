@@ -45,6 +45,8 @@ from photon_tpu.metrics.history import History
 from photon_tpu.strategy import dispatch_strategy
 from photon_tpu.strategy.base import ClientResult
 from photon_tpu.strategy.metrics import GradientNoiseScale
+from photon_tpu.utils.hostpool import HostPool
+from photon_tpu.utils.profiling import CKPT_ASYNC_WRITE_S
 
 
 class TooManyFailuresError(RuntimeError):
@@ -81,12 +83,23 @@ class ServerApp:
         self.ckpt_mgr = ckpt_mgr
         self.history = history or History()
         self.strategy = dispatch_strategy(cfg.fl)
+        # ONE bounded pool (``photon.host_threads``) serves the whole host
+        # plane: codec per-layer encode/decode, the per-array aggregation
+        # fold, and the one-client decode-ahead all draw from it
+        self.host_pool = HostPool(cfg.photon.host_threads)
+        transport.host_pool = self.host_pool
+        self.strategy.host_pool = self.host_pool
         if transport.codec is not None:
             # compressed fit results flow to the strategy UNdecoded; the
             # streaming aggregation dequantizes one client at a time through
             # this hook (the codec's reference is pinned per round by
-            # broadcast_parameters)
-            self.strategy.payload_decoder = transport.codec.decode
+            # broadcast_parameters). The per-layer decode fans back into the
+            # shared pool — safe because aggregation runs at most ONE such
+            # blocking lookahead task at a time (see utils/hostpool.py).
+            codec = transport.codec
+            self.strategy.payload_decoder = (
+                lambda p: codec.decode(p, pool=self.host_pool)
+            )
         self._wire_snapshot = transport.stats.snapshot()
         # fail fast on a typo'd per-round knob instead of shipping it to
         # every client each round (reference pydantic FitConfig validation,
@@ -149,20 +162,37 @@ class ServerApp:
         if self.ckpt_mgr is None:
             return
         assert self.strategy.current_parameters is not None
+        # the control-state snapshot is built NOW (client_states keeps
+        # mutating as later rounds merge results); the tensors themselves
+        # are safe to hand to a background writer by reference — strategies
+        # rebind, never mutate in place (see save_round_async)
+        server_state = {
+            "server_steps_cumulative": self.server_steps_cumulative,
+            "client_states": dict(self.client_states),
+            "history": self.history.to_dict(),
+            "rounds_sampled": self._rounds_sampled,
+            "gns": self.gns.state_dict(),
+            "run_uuid": self.cfg.run_uuid,
+            "saved_at": time.time(),
+        }
+        if self.cfg.photon.async_checkpoint:
+            # round N's write overlaps round N+1's broadcast + client fits;
+            # barrier at the next save/resume/shutdown (ISSUE 2 tentpole #4)
+            self.ckpt_mgr.save_round_async(
+                server_round,
+                self.metadata,
+                self.strategy.current_parameters,
+                self.strategy.state_for_checkpoint(),
+                server_state,
+                cleanup_keep=(self.cfg.photon.keep_checkpoints, self.strategy.state_keys),
+            )
+            return
         self.ckpt_mgr.save_round(
             server_round,
             self.metadata,
             self.strategy.current_parameters,
             self.strategy.state_for_checkpoint(),
-            {
-                "server_steps_cumulative": self.server_steps_cumulative,
-                "client_states": self.client_states,
-                "history": self.history.to_dict(),
-                "rounds_sampled": self._rounds_sampled,
-                "gns": self.gns.state_dict(),
-                "run_uuid": self.cfg.run_uuid,
-                "saved_at": time.time(),
-            },
+            server_state,
         )
         self.ckpt_mgr.cleanup(self.cfg.photon.keep_checkpoints, self.strategy.state_keys)
 
@@ -210,6 +240,7 @@ class ServerApp:
             self.transport.free(self._last_broadcast.params)
             self._last_broadcast = None
         self.transport.cleanup()
+        self.host_pool.close()
 
     def _sliding_window(
         self,
@@ -422,7 +453,15 @@ class ServerApp:
         try:
             self._round_loop(cfg, n_rounds)
         finally:
-            self.free_transport()
+            # shutdown barrier: the last round's background checkpoint write
+            # must land (and surface any error) before the loop returns —
+            # but a failed write must not leak the transport's shm segments
+            # or the pool, so free_transport runs regardless
+            try:
+                if self.ckpt_mgr is not None:
+                    self.ckpt_mgr.wait_pending()
+            finally:
+                self.free_transport()
         return self.history
 
     def _round_loop(self, cfg: Config, n_rounds: int) -> None:
@@ -461,6 +500,17 @@ class ServerApp:
             ):
                 t_ck = time.monotonic()
                 self.save_checkpoint(rnd)
+                # checkpoint_time = what the round loop was BLOCKED on:
+                # snapshot + enqueue, plus — when the store is slower than a
+                # round — the barrier wait for round N-1's write, reported
+                # separately below so slow-store regimes are visible. The
+                # write itself overlaps the next round and reports as
+                # CKPT_ASYNC_WRITE_S one round later.
                 metrics["server/checkpoint_time"] = time.monotonic() - t_ck
+                metrics[CKPT_ASYNC_WRITE_S] = float(self.ckpt_mgr.last_async_write_s)
+                if self.cfg.photon.async_checkpoint:
+                    metrics["server/ckpt_barrier_wait_s"] = float(
+                        self.ckpt_mgr.last_barrier_wait_s
+                    )
 
             self.history.record(rnd, metrics)

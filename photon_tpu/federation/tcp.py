@@ -262,7 +262,8 @@ def run_node(server_addr: str, node_id: str, cfg_json: str, retries: int = 30) -
 
     def make_transport() -> ParamTransport:
         mode = "objstore" if cfg.photon.comm_stack.objstore else "shm"
-        return ParamTransport(mode, store=store, compression=cfg.photon.compression)
+        return ParamTransport(mode, store=store, compression=cfg.photon.compression,
+                              host_threads=cfg.photon.host_threads)
 
     make_ckpt_mgr = None
     if store is not None and cfg.photon.checkpoint:

@@ -33,6 +33,7 @@ from photon_tpu.codec import ParamsMetadata
 from photon_tpu.compression import CompressedPayload, make_codec
 from photon_tpu.federation.messages import ParamPointer
 from photon_tpu.shm import plane as shm
+from photon_tpu.utils.hostpool import HostPool
 from photon_tpu.utils.profiling import WireStats
 
 #: reserved layer name carrying a serialized CompressedPayload through the
@@ -60,6 +61,7 @@ class ParamTransport:
         mode: str = "shm",
         store: ObjectStore | None = None,
         compression=None,
+        host_threads: int = 1,
     ) -> None:
         if mode not in ("shm", "objstore", "inline"):
             raise ValueError(f"unknown transport mode {mode!r}")
@@ -69,6 +71,11 @@ class ParamTransport:
         self.store = store
         self.codec = make_codec(compression)
         self.stats = WireStats()
+        # shared bounded pool for the codec's per-layer encode/decode
+        # (``photon.host_threads``; 1 = inline/serial, 0 = auto). ServerApp
+        # replaces this with ITS pool so aggregation fold, decode-ahead and
+        # codec work all draw from one bounded worker set.
+        self.host_pool = HostPool(host_threads)
         self._owned: list[str] = []  # shm segments we created (for cleanup)
 
     # -- compression -----------------------------------------------------
@@ -94,7 +101,8 @@ class ParamTransport:
         ``key`` names the error-feedback residual stream — the client id.
         """
         if compress and self.codec is not None:
-            payload = self.codec.encode(metadata, arrays, key=key)
+            payload = self.codec.encode(metadata, arrays, key=key,
+                                        pool=self.host_pool)
             blob = np.frombuffer(payload.to_bytes(), dtype=np.uint8)
             self.stats.record_sent(metadata.total_bytes, blob.nbytes)
             meta_d = json.loads(metadata.to_json())
@@ -159,7 +167,7 @@ class ParamTransport:
                 "payload but this transport has no codec — construct it with "
                 "the run's CompressionConfig"
             )
-        arrays = self.codec.decode(payload)
+        arrays = self.codec.decode(payload, pool=self.host_pool)
         metadata.validate_arrays(arrays)
         return metadata, arrays
 
@@ -199,3 +207,4 @@ class ParamTransport:
             elif self.mode == "objstore" and self.store is not None:
                 self.store.delete(name)
         self._owned.clear()
+        self.host_pool.close()  # reusable: next submit rebuilds the executor

@@ -9,18 +9,64 @@ materialized beyond the running average at any moment — maintaining
 per layer, where ``n_prev`` is the sample count already folded in, ``n_cur``
 the incoming client's count, ``n_new = n_prev + n_cur``. Mathematically equal
 to the sample-weighted mean but O(1) in memory w.r.t. client count.
+
+Host-plane pipeline (PR 2): the fold is a FUSED single pass — each incoming
+array is rescaled into the fp64 accumulator chunk by chunk, so the full-
+payload ``y.astype(np.float64)`` temporary of the two-pass fold (one extra
+fp64 model copy per client, ~1 GB at the 125M recipe) never exists; the peak
+transient is one ``_FOLD_CHUNK``-element chunk per worker. With a
+:class:`~photon_tpu.utils.hostpool.HostPool` the per-array folds run in
+parallel and the NEXT client's payload is fetched + decoded on the pool
+while the current one folds (bounded lookahead of 1). That relaxes the
+memory contract from "running average + 1 client" to "running average + 2
+clients" — still O(1) in client count. Every mode (serial, threads=1,
+threads=N) applies identical per-element operations in identical order, so
+the averaged result is BIT-IDENTICAL across configurations.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Iterable, Iterator
 
 import numpy as np
+
+from photon_tpu.utils.hostpool import HostPool
+
+#: elements per fold chunk (~8 MB of fp64 transient): large enough that the
+#: ufunc dominates the Python loop, small enough that per-worker transients
+#: stay invisible next to the accumulator
+_FOLD_CHUNK = 1 << 20
+
+
+def _fold_into(acc: np.ndarray, y: np.ndarray, w_prev: float, w_cur: float) -> None:
+    """``acc = acc * w_prev + y * w_cur`` as one chunked in-place pass.
+
+    Element-for-element this applies exactly the operations of the classic
+    two-pass fold (``acc *= w_prev; acc += y.astype(f64) * w_cur``) — same
+    multiplies, same add, same order — so results are bit-identical while
+    the full-array fp64 upcast of ``y`` is never materialized."""
+    flat_acc = acc.reshape(-1)
+    if not np.may_share_memory(flat_acc, acc):
+        # reshape COPIED (non-contiguous acc): the in-place fold below would
+        # mutate the copy and silently drop this client's contribution
+        raise ValueError("_fold_into needs a C-contiguous accumulator")
+    flat_y = np.asarray(y).reshape(-1)
+    for off in range(0, flat_acc.size, _FOLD_CHUNK):
+        sl = slice(off, off + _FOLD_CHUNK)
+        a = flat_acc[sl]
+        a *= w_prev
+        t = flat_y[sl].astype(np.float64)
+        t *= w_cur
+        a += t
+        del t  # else two chunk temps coexist across the loop boundary
 
 
 def aggregate_inplace(
     results: Iterable[tuple[object, int]],
     decode: Callable[[object], list[np.ndarray]] | None = None,
+    pool: HostPool | None = None,
+    timings: dict[str, float] | None = None,
 ) -> tuple[list[np.ndarray], int]:
     """Streaming sample-weighted mean over ``(arrays, n_samples)`` results.
 
@@ -31,8 +77,19 @@ def aggregate_inplace(
     A result's first element may also be a compressed payload
     (:class:`photon_tpu.compression.CompressedPayload`) when ``decode`` is
     given: each payload is dequantized HERE, one client at a time, so memory
-    stays O(1) in client count — only the running average plus the single
-    client being folded in are ever resident."""
+    stays O(1) in client count.
+
+    ``pool`` (a :class:`HostPool` with ``threads > 1``) enables the
+    pipelined path: per-array folds run in parallel and ONE lookahead
+    worker pulls + decodes the next result while the current one folds —
+    only that single worker ever advances the ``results`` iterator, so
+    generators with side effects (the server's sliding-window stream)
+    need no locking. Peak residency: running average + the folding client
+    + the decoded-ahead client.
+
+    ``timings`` (optional dict) accumulates ``decode_s`` (decode seconds
+    only, summed across workers — the blocking wait for a client's reply is
+    deliberately excluded) and ``fold_s`` (fold seconds)."""
 
     def _arrays(item) -> list[np.ndarray]:
         if isinstance(item, (list, tuple)):
@@ -45,34 +102,100 @@ def aggregate_inplace(
             )
         return decode(item)
 
+    t_decode = [0.0]
+    t_fold = [0.0]
     it: Iterator = iter(results)
-    try:
-        first, n_total = next(it)
-    except StopIteration:
-        raise ValueError("aggregate_inplace: empty results") from None
+
+    def _fetch_decode() -> tuple[list[np.ndarray], int] | None:
+        """Pull + decode the next result (runs on the pool when pipelined;
+        returns None at stream end — StopIteration must not cross the
+        future boundary). Only the DECODE is timed: ``next(it)`` blocks on
+        the driver until a client finishes its local fit, and charging
+        minutes of client training to ``agg_decode_time`` would drown the
+        host-work decomposition the KPI exists for."""
+        try:
+            item, n_cur = next(it)
+        except StopIteration:
+            return None
+        t0 = time.monotonic()
+        arrays = _arrays(item)
+        t_decode[0] += time.monotonic() - t0
+        return arrays, n_cur
+
+    first = _fetch_decode()
+    if first is None:
+        raise ValueError("aggregate_inplace: empty results")
+    arrays, n_total = first
     if n_total <= 0:
         raise ValueError(f"non-positive n_samples {n_total}")
-    acc = [np.asarray(a, dtype=np.float64) for a in _arrays(first)]
-    for item, n_cur in it:
-        if n_cur <= 0:
-            raise ValueError(f"non-positive n_samples {n_cur}")
-        arrays = _arrays(item)
-        if len(arrays) != len(acc):
-            # a shorter payload would fold PARTIALLY (acc tail never
-            # rescaled by w_prev for this client) — e.g. a momenta-extended
-            # checkpoint replayed into a momenta-less run
-            raise ValueError(
-                f"result has {len(arrays)} arrays, accumulator {len(acc)} "
-                "(momenta mismatch between payloads?)"
-            )
-        n_new = n_total + n_cur
-        w_prev = n_total / n_new
-        w_cur = n_cur / n_new
-        for i, y in enumerate(arrays):
-            acc[i] *= w_prev
-            acc[i] += np.asarray(y, dtype=np.float64) * w_cur
-        n_total = n_new
-    return [a.astype(np.float32) for a in acc], n_total
+
+    t0 = time.monotonic()
+    # order="C": _fold_into relies on acc.reshape(-1) being a VIEW — an
+    # already-fp64 non-contiguous first payload would otherwise pass through
+    # asarray unchanged and every later fold would land in a discarded copy
+    if pool is not None:
+        acc = pool.map(lambda a: np.asarray(a, dtype=np.float64, order="C"), arrays)
+    else:
+        acc = [np.asarray(a, dtype=np.float64, order="C") for a in arrays]
+    t_fold[0] += time.monotonic() - t0
+
+    pipelined = pool is not None and pool.pipelined
+    pending = pool.submit(_fetch_decode) if pipelined else None
+    try:
+        while True:
+            cur = pending.result() if pipelined else _fetch_decode()
+            if cur is None:
+                pending = None
+                break
+            if pipelined:
+                # decode-ahead: client k+1 fetches/dequantizes on the pool
+                # while client k folds below (bounded lookahead of 1)
+                pending = pool.submit(_fetch_decode)
+            arrays, n_cur = cur
+            if n_cur <= 0:
+                raise ValueError(f"non-positive n_samples {n_cur}")
+            if len(arrays) != len(acc):
+                # a shorter payload would fold PARTIALLY (acc tail never
+                # rescaled by w_prev for this client) — e.g. a momenta-
+                # extended checkpoint replayed into a momenta-less run
+                raise ValueError(
+                    f"result has {len(arrays)} arrays, accumulator {len(acc)} "
+                    "(momenta mismatch between payloads?)"
+                )
+            n_new = n_total + n_cur
+            w_prev = n_total / n_new
+            w_cur = n_cur / n_new
+            t0 = time.monotonic()
+            if pool is not None:
+                pool.map(
+                    lambda i, _a=arrays, _wp=w_prev, _wc=w_cur: _fold_into(
+                        acc[i], _a[i], _wp, _wc
+                    ),
+                    range(len(acc)),
+                )
+            else:
+                for a, y in zip(acc, arrays):
+                    _fold_into(a, y, w_prev, w_cur)
+            t_fold[0] += time.monotonic() - t0
+            n_total = n_new
+    except BaseException:
+        if pending is not None:
+            # best-effort: a queued lookahead is cancelled; a RUNNING one is
+            # left to finish on the (daemon-friendly) pool — the stream it
+            # holds belongs to a round that is already failing
+            pending.cancel()
+        raise
+
+    t0 = time.monotonic()
+    if pool is not None:
+        out = pool.map(lambda a: a.astype(np.float32), acc)
+    else:
+        out = [a.astype(np.float32) for a in acc]
+    t_fold[0] += time.monotonic() - t0
+    if timings is not None:
+        timings["decode_s"] = timings.get("decode_s", 0.0) + t_decode[0]
+        timings["fold_s"] = timings.get("fold_s", 0.0) + t_fold[0]
+    return out, n_total
 
 
 def weighted_loss_avg(results: Iterable[tuple[int, float]]) -> float:
@@ -89,15 +212,16 @@ def weighted_average_metrics(
     results: Iterable[tuple[int, dict[str, float]]],
 ) -> dict[str, float]:
     """Sample-weighted mean of per-client scalar metric dicts (reference:
-    ``strategy/aggregation.py:172`` ``weighted_average``)."""
-    results = [(n, m) for n, m in results]
-    total = sum(n for n, _ in results)
-    if total == 0:
-        return {}
-    keys: set[str] = set()
-    for _, m in results:
-        keys.update(m)
-    return {
-        k: float(sum(n * m[k] for n, m in results if k in m) / sum(n for n, m in results if k in m))
-        for k in keys
-    }
+    ``strategy/aggregation.py:172`` ``weighted_average``).
+
+    Single pass over the results: per-key numerator and denominator
+    accumulate together (the old per-key recompute was O(keys × clients)
+    passes over the result list). Keys carried only by zero-weight clients
+    are dropped rather than dividing by zero."""
+    num: dict[str, float] = {}
+    den: dict[str, int] = {}
+    for n, m in results:
+        for k, v in m.items():
+            num[k] = num.get(k, 0.0) + n * v
+            den[k] = den.get(k, 0) + n
+    return {k: float(num[k] / den[k]) for k in num if den[k] > 0}

@@ -21,6 +21,7 @@ from typing import Any, Iterable
 import numpy as np
 
 from photon_tpu.strategy.aggregation import aggregate_inplace, weighted_average_metrics
+from photon_tpu.utils.profiling import AGG_DECODE_TIME, AGG_FOLD_TIME
 
 
 @dataclasses.dataclass
@@ -71,6 +72,9 @@ class Strategy:
         #: decoder for compressed ClientResult payloads (wired by ServerApp
         #: when the transport carries a wire codec); None = raw arrays only
         self.payload_decoder = None
+        #: shared host thread pool (wired by ServerApp from
+        #: ``photon.host_threads``); None = fully serial aggregation
+        self.host_pool = None
 
     # ------------------------------------------------------------------
     def initialize(self, parameters: list[np.ndarray], state: dict[str, list[np.ndarray]] | None = None) -> None:
@@ -111,8 +115,19 @@ class Strategy:
                 seen.append((r.n_samples, r.metrics))
                 yield r.arrays, r.n_samples
 
-        avg, n_total = aggregate_inplace(stream(), decode=self.payload_decoder)
+        timings: dict[str, float] = {}
+        avg, n_total = aggregate_inplace(
+            stream(),
+            decode=self.payload_decoder,
+            pool=self.host_pool,
+            timings=timings,
+        )
         metrics = self.apply_average(server_round, avg, n_total, len(seen))
+        # host-plane KPI decomposition (utils/profiling.py): fetch+decode vs
+        # fold seconds of the streaming aggregation (summed across workers
+        # on the pipelined path, so they can exceed wall-clock)
+        metrics[AGG_DECODE_TIME] = timings.get("decode_s", 0.0)
+        metrics[AGG_FOLD_TIME] = timings.get("fold_s", 0.0)
         metrics.update(weighted_average_metrics(seen))
         return self.current_parameters, metrics
 

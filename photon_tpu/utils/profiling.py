@@ -22,6 +22,19 @@ from typing import Iterator
 from photon_tpu.config.schema import ModelConfig
 
 
+# Host-plane round-pipeline KPI names (PR 2). Recorded into the round
+# metrics by the strategy / server so the History tracks where the host
+# seconds between device rounds actually go:
+#: fetch + dequantize seconds of the streaming aggregation (summed across
+#: pool workers — can exceed wall-clock on the pipelined path)
+AGG_DECODE_TIME = "server/agg_decode_time"
+#: fused fold seconds of the streaming aggregation
+AGG_FOLD_TIME = "server/agg_fold_time"
+#: duration of the most recently COMPLETED background checkpoint write
+#: (round N's metrics carry round N-1's write; 0.0 until one completes)
+CKPT_ASYNC_WRITE_S = "server/ckpt_async_write_s"
+
+
 @dataclasses.dataclass
 class WireStats:
     """Bytes-on-wire accounting for the parameter plane.

@@ -45,6 +45,35 @@ def test_bounded_prefetch_leaves_loader_state_exact(tmp_path):
     del got
 
 
+def test_close_joins_producer_despite_drain_race():
+    """Regression (ISSUE 2 satellite): the old ``close()`` drained once and
+    returned — a producer that refilled the queue after that drain blocked
+    forever (the post-loop ``put(_DONE)`` had no stop check at all),
+    leaking a permanently wedged thread. ``close()`` must now JOIN the
+    producer, whatever state it is blocked in."""
+    # finite source + depth 1 reproduces the worst case: the producer ends
+    # its loop with the queue full and goes on to put(_DONE)
+    it = PrefetchIterator(iter(range(3)), depth=1)
+    assert next(it) == 0
+    it.close()
+    assert not it._thread.is_alive(), "producer thread leaked past close()"
+
+
+def test_close_with_infinite_source_and_consumer_gone():
+    def forever():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    it = PrefetchIterator(forever(), depth=2)
+    assert next(it) == 0
+    it.close()
+    assert not it._thread.is_alive()
+    # idempotent
+    it.close()
+
+
 def test_trainer_fit_with_loader_resume_exact(tmp_path, tiny_trainer):
     """fit() consuming from a StreamingLoader must leave its state exactly
     duration_steps × batch ahead (prefetch is bounded)."""

@@ -143,6 +143,28 @@ def test_weighted_loss_avg():
     assert weighted_loss_avg([(1, 2.0), (3, 4.0)]) == pytest.approx((2 + 12) / 4)
 
 
+def test_weighted_average_metrics_ragged_exact():
+    """Single-pass rewrite (ISSUE 2 satellite): exact values pinned on a
+    ragged metrics dict — every key normalizes by the samples of the
+    clients that REPORTED it, not the round total."""
+    from photon_tpu.strategy import weighted_average_metrics
+
+    results = [
+        (2, {"loss": 4.0, "acc": 0.5}),
+        (6, {"loss": 2.0}),                 # no "acc"
+        (4, {"acc": 1.0, "extra": 7.0}),    # no "loss"
+        (0, {"ghost": 3.0}),                # zero-weight: must not divide by 0
+    ]
+    out = weighted_average_metrics(results)
+    assert out == {
+        "loss": pytest.approx((2 * 4.0 + 6 * 2.0) / 8),   # 2.5 over 8 samples
+        "acc": pytest.approx((2 * 0.5 + 4 * 1.0) / 6),    # 5/6 over 6 samples
+        "extra": pytest.approx(7.0),
+    }
+    assert "ghost" not in out
+    assert weighted_average_metrics([]) == {}
+
+
 def test_metrics_weighted_and_telemetry():
     s = FedAvgEff(server_learning_rate=1.0)
     s.initialize(arrs(1.0))
