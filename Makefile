@@ -1,7 +1,7 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test test-all bench bench-host native clean
+.PHONY: test test-all bench bench-host chaos native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
@@ -21,6 +21,16 @@ bench:
 # CPU-runnable, no relay/TPU claim
 bench-host:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --host-plane
+
+# the chaos-marked fault-injection + elasticity suite (incl. the slow
+# SIGKILL/rejoin e2es): deterministic — every test pins
+# ChaosConfig(seed=1234) and the injector streams are pure functions of
+# (seed, node_id). Scoped to the files carrying chaos-marked tests so
+# unrelated collection state can't mask a red suite.
+chaos:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_chaos.py tests/test_membership.py tests/test_tcp_driver.py \
+		tests/test_checkpoint.py tests/test_shm.py -q -m chaos
 
 native: native/libphoton_native.so
 

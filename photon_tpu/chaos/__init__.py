@@ -1,0 +1,42 @@
+"""Deterministic fault injection (``photon.chaos``).
+
+The reference's robustness claim (PAPER.md §5, SURVEY "Failure detection /
+elastic recovery") is that federated pre-training survives unreliable
+participants. Claims like that rot unless the failures are *mechanically
+reproducible* — the same way EQuARX-style wire tricks (PAPERS.md) only
+became shippable once bit-exactness was checkable. This package makes every
+failure mode the federation stack must survive an injectable, seeded event:
+
+- control plane (``federation/tcp.py``): drop / delay / duplicate /
+  corrupt an envelope frame (corruption is caught by the CRC32 framing);
+- object store (``checkpoint/store.py``): slow writes, partial ``.tmp``
+  files that never rename into place, bit-flipped payloads (caught by the
+  checkpoint manifest checksums);
+- node process (``federation/node.py`` / ``client_runtime.py``): crash at a
+  chosen phase — ``pre-fit`` | ``mid-fit`` | ``pre-reply`` — via
+  ``os._exit``, indistinguishable from SIGKILL.
+
+Disabled (the default), every hook site is a module-global load plus a
+``None`` check — no rng draws, no branches into fault logic. ``photon.chaos``
+must be OFF in production configs (see docs/failure_semantics.md).
+"""
+
+from photon_tpu.chaos.injector import (
+    FaultInjector,
+    StoreFaultPlan,
+    TcpFaultPlan,
+    active,
+    crash_point,
+    install,
+    uninstall,
+)
+
+__all__ = [
+    "FaultInjector",
+    "StoreFaultPlan",
+    "TcpFaultPlan",
+    "active",
+    "crash_point",
+    "install",
+    "uninstall",
+]

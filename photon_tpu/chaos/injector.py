@@ -1,0 +1,194 @@
+"""The seeded fault injector and its process-global installation.
+
+Determinism contract: an injector's fault schedule is a pure function of
+``(ChaosConfig.seed, scope)`` and the *order* of hook calls in its process.
+``scope`` is the node id (or ``"server"``), so a multi-process run replays
+the same faults per process across reruns even though processes interleave
+nondeterministically with each other.
+
+Hook sites call :func:`active` (a module-global read) and do nothing when it
+returns ``None`` — the disabled path is provably a no-op, which is what lets
+``photon.chaos`` exist in the tree without taxing the bench host-plane path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import zlib
+from typing import Callable
+
+# resolved lazily to avoid a config<->chaos import cycle: config/schema.py
+# validates ChaosConfig fields, chaos only reads them
+
+_PHASES = ("pre-fit", "mid-fit", "pre-reply")
+
+
+@dataclasses.dataclass
+class TcpFaultPlan:
+    """One envelope send's fate (all fields independent draws)."""
+
+    drop: bool = False
+    delay_s: float = 0.0
+    duplicate: bool = False
+    corrupt: bool = False
+
+
+@dataclasses.dataclass
+class StoreFaultPlan:
+    """One object-store write's fate."""
+
+    delay_s: float = 0.0
+    # write the temp file but never rename it into place — the torn-write /
+    # crash-mid-upload shape the atomic-rename protocol is meant to mask
+    partial: bool = False
+    # flip one payload bit BEFORE the (otherwise atomic, durable) write —
+    # lands a well-formed object with wrong bytes; only checksums catch it
+    bitflip: bool = False
+
+
+def _scope_seed(seed: int, scope: str) -> int:
+    return (int(seed) ^ zlib.crc32(scope.encode())) & 0x7FFFFFFF
+
+
+class FaultInjector:
+    """Draws fault plans from a seeded stream; one instance per process.
+
+    ``crash_fn`` is injectable for unit tests; the default ``os._exit(137)``
+    is deliberately un-catchable from Python — no ``finally`` blocks, no
+    atexit, exactly like SIGKILL landing mid-instruction.
+    """
+
+    def __init__(self, cfg, scope: str = "", crash_fn: Callable[[int], None] | None = None) -> None:
+        self.cfg = cfg
+        self.scope = scope
+        self.rng = random.Random(_scope_seed(cfg.seed, scope))
+        self.crash_fn = crash_fn or (lambda code: os._exit(code))
+        # per-plan counters so tests can assert the schedule fired
+        self.counts: dict[str, int] = {
+            "tcp_drop": 0, "tcp_delay": 0, "tcp_duplicate": 0, "tcp_corrupt": 0,
+            "store_slow": 0, "store_partial": 0, "store_bitflip": 0, "crash": 0,
+        }
+
+    # -- TCP control plane ----------------------------------------------
+    def tcp_plan(self) -> TcpFaultPlan:
+        c = self.cfg
+        plan = TcpFaultPlan()
+        if c.tcp_drop_p and self.rng.random() < c.tcp_drop_p:
+            plan.drop = True
+            self.counts["tcp_drop"] += 1
+            return plan  # a dropped frame can't also be delayed/duplicated
+        if c.tcp_delay_p and self.rng.random() < c.tcp_delay_p:
+            plan.delay_s = self.rng.uniform(0.0, c.tcp_delay_max_s)
+            self.counts["tcp_delay"] += 1
+        if c.tcp_duplicate_p and self.rng.random() < c.tcp_duplicate_p:
+            plan.duplicate = True
+            self.counts["tcp_duplicate"] += 1
+        if c.tcp_corrupt_p and self.rng.random() < c.tcp_corrupt_p:
+            plan.corrupt = True
+            self.counts["tcp_corrupt"] += 1
+        return plan
+
+    def corrupt_bytes(self, data: bytes) -> bytes:
+        """Flip one bit at a seeded offset (never a no-op)."""
+        if not data:
+            return data
+        buf = bytearray(data)
+        i = self.rng.randrange(len(buf))
+        buf[i] ^= 1 << self.rng.randrange(8)
+        return bytes(buf)
+
+    # -- object store ----------------------------------------------------
+    def store_plan(self) -> StoreFaultPlan:
+        c = self.cfg
+        plan = StoreFaultPlan()
+        if c.store_slow_p and self.rng.random() < c.store_slow_p:
+            plan.delay_s = self.rng.uniform(0.0, c.store_slow_max_s)
+            self.counts["store_slow"] += 1
+        if c.store_partial_p and self.rng.random() < c.store_partial_p:
+            plan.partial = True
+            self.counts["store_partial"] += 1
+        elif c.store_bitflip_p and self.rng.random() < c.store_bitflip_p:
+            plan.bitflip = True
+            self.counts["store_bitflip"] += 1
+        return plan
+
+    # -- node crash ------------------------------------------------------
+    def maybe_crash(self, phase: str, server_round: int = 0, node_id: str = "") -> None:
+        c = self.cfg
+        if not c.crash_phase or c.crash_phase != phase:
+            return
+        if c.crash_round and server_round != c.crash_round:
+            return
+        if c.crash_node_id and node_id and node_id != c.crash_node_id:
+            return
+        if c.crash_marker:
+            # the marker survives the process the crash kills: a respawned
+            # node (same config) sees it and stays up, making "SIGKILL the
+            # node exactly once" a deterministic, testable event
+            try:
+                fd = os.open(c.crash_marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                return  # already crashed once
+            except OSError:
+                return  # unreachable marker path: fail open (no crash)
+            os.close(fd)
+        self.counts["crash"] += 1
+        self.crash_fn(137)
+
+
+# -- process-global installation ----------------------------------------
+
+_INJECTOR: FaultInjector | None = None
+
+
+def install(cfg, scope: str = "", crash_fn: Callable[[int], None] | None = None) -> FaultInjector | None:
+    """Install (or clear) the process-global injector from a ChaosConfig.
+
+    ``cfg=None`` or ``cfg.enabled=False`` uninstalls — constructing a
+    ServerApp with chaos off always leaves a clean process, so test
+    pollution across configs is impossible.
+    """
+    global _INJECTOR
+    if cfg is None or not getattr(cfg, "enabled", False):
+        _INJECTOR = None
+        return None
+    _INJECTOR = FaultInjector(cfg, scope=scope, crash_fn=crash_fn)
+    return _INJECTOR
+
+
+def uninstall() -> None:
+    global _INJECTOR
+    _INJECTOR = None
+
+
+def active() -> FaultInjector | None:
+    """The installed injector, or None — the single check every hook makes."""
+    return _INJECTOR
+
+
+def crash_point(phase: str, server_round: int = 0, node_id: str = "") -> None:
+    """Hook site for node-process crash phases (no-op unless installed)."""
+    inj = _INJECTOR
+    if inj is not None:
+        inj.maybe_crash(phase, server_round, node_id)
+
+
+def validate_chaos_config(cfg) -> None:
+    """Schema-side validation (called from ``Config.validate``)."""
+    for name in (
+        "tcp_drop_p", "tcp_delay_p", "tcp_duplicate_p", "tcp_corrupt_p",
+        "store_slow_p", "store_partial_p", "store_bitflip_p",
+    ):
+        v = getattr(cfg, name)
+        if not 0.0 <= v <= 1.0:
+            raise ValueError(f"chaos.{name} must be in [0, 1], got {v}")
+    if cfg.tcp_delay_max_s < 0 or cfg.store_slow_max_s < 0:
+        raise ValueError("chaos delay bounds must be >= 0")
+    if cfg.crash_phase and cfg.crash_phase not in _PHASES:
+        raise ValueError(
+            f"chaos.crash_phase must be one of {_PHASES} or '', got {cfg.crash_phase!r}"
+        )
+    if cfg.crash_round < 0:
+        raise ValueError(f"chaos.crash_round must be >= 0, got {cfg.crash_round}")

@@ -16,8 +16,11 @@ Reference semantics (``photon/server/s3_utils.py``):
 
 from __future__ import annotations
 
+import json
 import threading
 import time
+import warnings
+import zlib
 from typing import Any
 
 import numpy as np
@@ -33,6 +36,10 @@ from photon_tpu.codec import ParamsMetadata
 
 PARAMS_FILE = "current_server_parameters.npz"
 STATE_FILE = "state.bin"
+# per-object CRC32s, written LAST: presence marks the round complete, the
+# checksums let resume detect a bit-flipped/torn object and fall back to the
+# previous valid round instead of resuming garbage
+MANIFEST_FILE = "manifest.json"
 
 
 class ServerCheckpointManager:
@@ -45,6 +52,12 @@ class ServerCheckpointManager:
         self._pending_error: BaseException | None = None
         self._last_async_write_s = 0.0
         self._last_barrier_wait_s = 0.0
+        # per-round checksum-verification memo (own run only): a completed
+        # round's bytes never legitimately change, so each round is read
+        # back and CRC'd at most once per manager lifetime — this keeps the
+        # GC's corruption-awareness (cleanup must not count a corrupt round
+        # toward `keep`) from re-reading every kept round every round
+        self._verify_cache: dict[int, bool] = {}
 
     # -- async writer ----------------------------------------------------
     @property
@@ -133,9 +146,19 @@ class ServerCheckpointManager:
         server_state: dict[str, Any] | None = None,
     ) -> None:
         prefix = self._round_prefix(server_round)
-        # state.bin last: its presence marks the round complete only after
-        # params/momenta landed (writes are atomic per object)
-        self.store.put(f"{prefix}/{PARAMS_FILE}", arrays_to_npz(metadata, parameters))
+        # a resumed run rewrites rounds above the resume point: any memoized
+        # verdict for the old bytes is stale now
+        self._verify_cache.pop(server_round, None)
+        manifest: dict[str, int] = {}
+
+        def _put(name: str, data: bytes) -> None:
+            self.store.put(f"{prefix}/{name}", data)
+            manifest[name] = zlib.crc32(data)
+
+        # manifest.json last: its presence marks the round complete only
+        # after params/momenta/state landed (writes are atomic per object),
+        # and its checksums are what resume verifies
+        _put(PARAMS_FILE, arrays_to_npz(metadata, parameters))
         for key, tensors in (strategy_state or {}).items():
             # per-layer state aligns 1:1 with the (already canonically sorted)
             # param names; odd-length state (e.g. FedAdam's step counter) gets
@@ -146,8 +169,12 @@ class ServerCheckpointManager:
                 else [f"{i:06d}" for i in range(len(tensors))]
             )
             meta = ParamsMetadata.from_ndarrays(names, tensors)
-            self.store.put(f"{prefix}/{key}.npz", arrays_to_npz(meta, tensors))
-        self.store.put(f"{prefix}/{STATE_FILE}", state_to_bytes(server_state or {}))
+            _put(f"{key}.npz", arrays_to_npz(meta, tensors))
+        _put(STATE_FILE, state_to_bytes(server_state or {}))
+        self.store.put(
+            f"{prefix}/{MANIFEST_FILE}",
+            json.dumps({"version": 1, "crc32": manifest}).encode(),
+        )
 
     # -- discovery -------------------------------------------------------
     def list_rounds(self, run_uuid: str | None = None) -> list[int]:
@@ -163,20 +190,62 @@ class ServerCheckpointManager:
         return sorted(rounds)
 
     def is_valid_round(
-        self, server_round: int, state_keys: tuple[str, ...] = (), run_uuid: str | None = None
+        self,
+        server_round: int,
+        state_keys: tuple[str, ...] = (),
+        run_uuid: str | None = None,
+        verify_checksums: bool = False,
     ) -> bool:
+        """Presence check (cheap: GC and discovery run it every round);
+        ``verify_checksums=True`` additionally CRCs every object against the
+        round manifest — the resume path pays that read cost so it never
+        resumes a bit-flipped/torn checkpoint."""
         prefix = self._round_prefix(server_round, run_uuid)
         needed = [f"{prefix}/{PARAMS_FILE}", f"{prefix}/{STATE_FILE}"]
         needed += [f"{prefix}/{k}.npz" for k in state_keys]
-        return all(self.store.exists(k) for k in needed)
+        if not all(self.store.exists(k) for k in needed):
+            return False
+        if not verify_checksums:
+            return True
+        return self.verify_round(server_round, state_keys, run_uuid)
+
+    def verify_round(
+        self, server_round: int, state_keys: tuple[str, ...] = (), run_uuid: str | None = None
+    ) -> bool:
+        """CRC32-check every object listed in the round's manifest. Rounds
+        written before the manifest existed verify vacuously (presence was
+        their only contract). Results for THIS run are memoized — completed
+        rounds are immutable, and a cached False stays False."""
+        del state_keys  # the manifest lists exactly what the round wrote
+        own = run_uuid is None or run_uuid == self.run_uuid
+        if own and server_round in self._verify_cache:
+            return self._verify_cache[server_round]
+        prefix = self._round_prefix(server_round, run_uuid)
+        mkey = f"{prefix}/{MANIFEST_FILE}"
+        ok = True
+        if self.store.exists(mkey):  # pre-manifest checkpoints verify vacuously
+            try:
+                manifest = json.loads(self.store.get(mkey).decode())
+                for name, crc in manifest.get("crc32", {}).items():
+                    if zlib.crc32(self.store.get(f"{prefix}/{name}")) != int(crc):
+                        ok = False
+                        break
+            except (OSError, ValueError, KeyError):
+                ok = False  # unreadable/torn manifest = invalid round
+        if own:
+            self._verify_cache[server_round] = ok
+        return ok
 
     def valid_rounds(self, state_keys: tuple[str, ...] = ()) -> list[int]:
         return [r for r in self.list_rounds() if self.is_valid_round(r, state_keys)]
 
     def resolve_resume_round(self, resume_round: int, state_keys: tuple[str, ...] = ()) -> int:
-        """Non-negative → that round (validated). Negative → index from the
-        latest valid round: −1 = latest, −2 = one before, ... (reference:
-        ``s3_utils.py:1261-1318``)."""
+        """Non-negative → that round (validated, incl. checksums). Negative →
+        index from the latest valid round: −1 = latest, −2 = one before, ...
+        (reference: ``s3_utils.py:1261-1318``). A round whose objects fail
+        the manifest checksums is SKIPPED (with a warning) and the index
+        falls back to the previous checksum-valid round — resuming garbage
+        is strictly worse than resuming older."""
         self.wait_pending()  # resume must see every completed async write
         valid = self.valid_rounds(state_keys)
         if not valid:
@@ -186,10 +255,28 @@ class ServerCheckpointManager:
                 raise FileNotFoundError(
                     f"round {resume_round} is not a valid checkpoint (valid: {valid})"
                 )
+            if not self.verify_round(resume_round, state_keys):
+                raise FileNotFoundError(
+                    f"round {resume_round} checkpoint failed checksum verification "
+                    "(corrupt object); pick another round or a negative index"
+                )
             return resume_round
-        if -resume_round > len(valid):
-            raise FileNotFoundError(f"resume_round {resume_round} but only {len(valid)} valid")
-        return valid[resume_round]
+        want = -resume_round
+        seen_ok = 0
+        for r in reversed(valid):
+            if not self.verify_round(r, state_keys):
+                warnings.warn(
+                    f"round {r} checkpoint failed checksum verification — "
+                    "skipping it for resume",
+                    stacklevel=2,
+                )
+                continue
+            seen_ok += 1
+            if seen_ok == want:
+                return r
+        raise FileNotFoundError(
+            f"resume_round {resume_round} but only {seen_ok} checksum-valid rounds"
+        )
 
     # -- load ------------------------------------------------------------
     def load_round(
@@ -209,13 +296,22 @@ class ServerCheckpointManager:
     def cleanup(self, keep: int, state_keys: tuple[str, ...] = ()) -> list[int]:
         """Delete all but the newest ``keep`` valid rounds; invalid (partial)
         rounds older than the newest valid one are removed too. Returns the
-        deleted round numbers."""
-        valid = self.valid_rounds(state_keys)
+        deleted round numbers.
+
+        ``keep`` counts CHECKSUM-valid rounds (memoized — one read-back per
+        round per manager lifetime): a bit-flipped newest round must not
+        push the good rounds the resume fallback needs out of the window.
+        Corrupt/partial rounds newer than the newest good one are kept as
+        forensics; older ones are garbage."""
+        valid = [
+            r for r in self.valid_rounds(state_keys) if self.verify_round(r, state_keys)
+        ]
         keep_set = set(valid[-keep:]) if keep > 0 else set(valid)
         deleted = []
         for r in self.list_rounds():
             if r not in keep_set and (r in valid or (valid and r < valid[-1])):
                 self.store.delete(self._round_prefix(r))
+                self._verify_cache.pop(r, None)
                 deleted.append(r)
         return deleted
 
@@ -231,5 +327,6 @@ class ServerCheckpointManager:
             for key in self.store.list(src):
                 rel = key[len(src) :].lstrip("/")
                 self.store.copy(key, f"{dst}/{rel}")
+            self._verify_cache.pop(r, None)  # fresh bytes under this run
             imported.append(r)
         return imported

@@ -10,7 +10,11 @@ into the image, so the remote backend is gated).
 Writes are atomic (temp file + rename) so readers polling ``exists`` never
 observe partial objects — the property the reference gets from S3's atomic
 PUT and relies on in ``validate_given_remote_path`` polling
-(``s3_utils.py:812-864``).
+(``s3_utils.py:812-864``). They are also durable: the temp file is fsynced
+before the rename and the parent directory after it, so a host crash right
+after ``put`` returns cannot surface an empty/torn object that passes the
+``exists`` check. The ``photon.chaos`` injector can fault writes (slow /
+partial / bit-flipped) to prove the readers' defenses.
 """
 
 from __future__ import annotations
@@ -21,11 +25,16 @@ import shutil
 import time
 from typing import Iterable
 
+from photon_tpu import chaos
+
 
 class ObjectStore:
     """Key → bytes. Keys are '/'-separated paths."""
 
-    def put(self, key: str, data: bytes) -> None:
+    def put(self, key: str, data: bytes, durable: bool = True) -> None:
+        """Atomic write. ``durable=False`` may skip crash-durability work
+        (fsync) for transient objects — the param-transport plane deletes
+        its objects at round end, so flushing them buys nothing."""
         raise NotImplementedError
 
     def get(self, key: str) -> bytes:
@@ -76,12 +85,48 @@ class FileStore(ObjectStore):
             raise ValueError(f"key escapes store root: {key!r}")
         return p
 
-    def put(self, key: str, data: bytes) -> None:
+    def put(self, key: str, data: bytes, durable: bool = True) -> None:
         p = self._path(key)
         p.parent.mkdir(parents=True, exist_ok=True)
         tmp = p.parent / f".{p.name}.tmp-{os.getpid()}"
-        tmp.write_bytes(data)
+        inj = chaos.active()
+        if inj is not None:
+            plan = inj.store_plan()
+            if plan.delay_s:
+                time.sleep(plan.delay_s)
+            if plan.bitflip:
+                data = inj.corrupt_bytes(data)
+            if plan.partial:
+                # crash-mid-upload shape: the temp file lands (possibly
+                # truncated) but never renames into place — readers polling
+                # ``exists`` keep seeing nothing, exactly as designed
+                tmp.write_bytes(data[: max(0, len(data) // 2)])
+                return
+        if not durable:
+            # transient objects (param-transport plane): atomicity without
+            # the flush — they're deleted at round end anyway
+            tmp.write_bytes(data)
+            os.rename(tmp, p)
+            return
+        # durability order matters: flush+fsync the temp file BEFORE the
+        # rename (else a host crash after rename can surface an empty/torn
+        # object that passes the ``exists`` check), then fsync the parent
+        # directory so the rename itself is on disk
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
         os.rename(tmp, p)
+        try:
+            dirfd = os.open(p.parent, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+        except OSError:
+            return  # exotic fs without directory opens: rename is still atomic
+        try:
+            os.fsync(dirfd)
+        except OSError:
+            pass  # directory fsync unsupported (some network mounts)
+        finally:
+            os.close(dirfd)
 
     def get(self, key: str) -> bytes:
         return self._path(key).read_bytes()
@@ -139,9 +184,11 @@ class S3Store(ObjectStore):
         key = key.strip("/")
         return f"{self.prefix}/{key}" if self.prefix else key
 
-    def put(self, key: str, data: bytes) -> None:
-        # S3 PUT is atomic: readers never observe partial objects (the
-        # property the reference polls on, ``s3_utils.py:812-864``)
+    def put(self, key: str, data: bytes, durable: bool = True) -> None:
+        # S3 PUT is atomic AND durable on success: readers never observe
+        # partial objects (the property the reference polls on,
+        # ``s3_utils.py:812-864``); the durable flag has nothing to skip
+        del durable
         self.client.put_object(Bucket=self.bucket, Key=self._key(key), Body=data)
 
     def get(self, key: str) -> bytes:

@@ -243,6 +243,66 @@ class CompressionConfig:
 
 
 @dataclass
+class ChaosConfig:
+    """Deterministic fault injection (``photon_tpu/chaos``).
+
+    OFF by default, and MUST stay off in production configs — every knob
+    here exists to make the failure modes the federation stack claims to
+    survive mechanically reproducible in tests (``make chaos``). Disabled,
+    every hook site is a None-check; no fault logic runs.
+    """
+
+    enabled: bool = False
+    seed: int = 1234  # per-process stream is seeded by (seed, scope=node_id)
+    # control plane: per-Envelope-frame fault probabilities (federation/tcp.py)
+    tcp_drop_p: float = 0.0
+    tcp_delay_p: float = 0.0
+    tcp_delay_max_s: float = 0.05
+    tcp_duplicate_p: float = 0.0
+    tcp_corrupt_p: float = 0.0  # one-bit flip; caught by CRC32 framing
+    # object store: per-write fault probabilities (checkpoint/store.py)
+    store_slow_p: float = 0.0
+    store_slow_max_s: float = 0.05
+    store_partial_p: float = 0.0  # temp file written, never renamed into place
+    store_bitflip_p: float = 0.0  # caught by checkpoint manifest checksums
+    # node crash: os._exit (SIGKILL-equivalent) at a phase of fit handling
+    crash_phase: str = ""  # "" | pre-fit | mid-fit | pre-reply
+    crash_round: int = 0  # only when serving this server_round (0 = any)
+    crash_node_id: str = ""  # only on this node id ("" = any)
+    # marker-file path making the crash one-shot across respawns: the file
+    # survives the killed process; a respawned node sees it and stays up
+    crash_marker: str = ""
+
+
+@dataclass
+class MembershipConfig:
+    """Elastic node membership (``federation/membership.py``).
+
+    Server side: a ping sweep between rounds drives each node through the
+    ``live → suspect → dead → readmitted`` state machine; a node that
+    reappears (TCP re-HELLO, multiprocess respawn) rejoins the rotation and
+    gets the current round's broadcast re-sent. Node side: the reconnect
+    supervisor redials with jittered exponential backoff and re-HELLOs.
+
+    ``enabled`` gates ONLY the between-rounds ping sweep (the proactive
+    suspect/dead detection). Scheduling-level crash recovery — dead-letter
+    handling, mid-round readmission with a broadcast re-send, the liveness
+    KPIs — is core round-loop behavior and always on.
+    """
+
+    enabled: bool = True
+    ping_interval_rounds: int = 1  # sweep every N rounds (0 = never)
+    ping_timeout_s: float = 5.0
+    suspect_after_misses: int = 1
+    dead_after_misses: int = 2
+    # node-side reconnect backoff: delay(k) = min(max, base·2^k) ± jitter
+    reconnect_backoff_base_s: float = 0.5
+    reconnect_backoff_max_s: float = 30.0
+    reconnect_backoff_jitter: float = 0.25  # ± fraction of the raw delay
+    reconnect_max_attempts: int = 60  # consecutive failed dials before giving up (0 = unlimited)
+
+
+@dataclass
 class FLConfig:
     """Federation hyperparameters (reference: ``base_schema.py`` fl block)."""
 
@@ -303,6 +363,8 @@ class PhotonConfig:
     init_from_run: str | None = None
     comm_stack: CommStackConfig = field(default_factory=CommStackConfig)
     compression: CompressionConfig = field(default_factory=CompressionConfig)
+    membership: MembershipConfig = field(default_factory=MembershipConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
     save_path: str = "/tmp/photon_tpu"
 
 
@@ -469,6 +531,44 @@ class Config:
         if comp.ef_max_clients < 1:
             raise ValueError(
                 f"compression.ef_max_clients must be >= 1, got {comp.ef_max_clients}"
+            )
+        mem = self.photon.membership
+        if mem.ping_interval_rounds < 0 or mem.ping_timeout_s < 0:
+            raise ValueError("membership ping knobs must be >= 0")
+        if mem.suspect_after_misses < 1 or mem.dead_after_misses < mem.suspect_after_misses:
+            raise ValueError(
+                "membership needs 1 <= suspect_after_misses <= dead_after_misses, got "
+                f"{mem.suspect_after_misses}/{mem.dead_after_misses}"
+            )
+        if mem.reconnect_backoff_base_s <= 0 or mem.reconnect_backoff_max_s < mem.reconnect_backoff_base_s:
+            raise ValueError(
+                "membership reconnect backoff needs 0 < base_s <= max_s, got "
+                f"{mem.reconnect_backoff_base_s}/{mem.reconnect_backoff_max_s}"
+            )
+        if not 0.0 <= mem.reconnect_backoff_jitter < 1.0:
+            raise ValueError(
+                f"membership.reconnect_backoff_jitter must be in [0, 1), got "
+                f"{mem.reconnect_backoff_jitter}"
+            )
+        if mem.reconnect_max_attempts < 0:
+            raise ValueError("membership.reconnect_max_attempts must be >= 0 (0 = unlimited)")
+        from photon_tpu.chaos.injector import validate_chaos_config
+
+        validate_chaos_config(self.photon.chaos)
+        if not self.photon.chaos.enabled and (
+            self.photon.chaos.crash_phase
+            or any(
+                getattr(self.photon.chaos, p) > 0.0
+                for p in (
+                    "tcp_drop_p", "tcp_delay_p", "tcp_duplicate_p", "tcp_corrupt_p",
+                    "store_slow_p", "store_partial_p", "store_bitflip_p",
+                )
+            )
+        ):
+            warnings.warn(
+                "photon.chaos knobs are set but chaos.enabled=False — no "
+                "faults will be injected",
+                stacklevel=2,
             )
         if comp.policy != "off" and self.photon.comm_stack.collective:
             raise ValueError(

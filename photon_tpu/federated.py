@@ -150,7 +150,12 @@ def main(argv: list[str] | None = None) -> None:
         history = app.run(args.rounds)
     finally:
         app.driver.shutdown()
-    final = {k: history.latest(k) for k in ("server/round_time", "server/eval_loss", "server/pseudo_grad_norm")}
+    final = {k: history.latest(k) for k in ("server/round_time", "server/eval_loss", "server/pseudo_grad_norm", "server/nodes_live")}
+    # run-level elasticity summary: total readmissions says whether the
+    # fleet churned — 0.0 on a healthy run, so presence is keyed on the
+    # series existing, not on the total being nonzero
+    if history.series("server/nodes_readmitted"):
+        final["server/nodes_readmitted_total"] = history.cumulative("server/nodes_readmitted")
     print(json.dumps({"rounds": args.rounds or cfg.fl.n_rounds, **{k: v for k, v in final.items() if v is not None}}))
 
 

@@ -16,6 +16,7 @@ from photon_tpu.federation.messages import (
     ParamPointer,
     Query,
 )
+from photon_tpu.federation.membership import LivenessTracker, ReconnectPolicy
 from photon_tpu.federation.node import NodeAgent
 from photon_tpu.federation.server import ServerApp, TooManyFailuresError
 from photon_tpu.federation.transport import ParamTransport
@@ -24,8 +25,10 @@ __all__ = [
     "ClientRuntime",
     "Driver",
     "InProcessDriver",
+    "LivenessTracker",
     "MultiprocessDriver",
     "NodeAgent",
+    "ReconnectPolicy",
     "ServerApp",
     "TooManyFailuresError",
     "ParamTransport",

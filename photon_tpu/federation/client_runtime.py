@@ -237,6 +237,12 @@ class ClientRuntime:
             loader.skip_samples(state_in.samples_cumulative)
 
         t_fit0 = time.monotonic()
+        # chaos "mid-fit": params are on device, the loader is positioned,
+        # the train loop is about to burn steps — dying here loses real work
+        # and leaves loader/optimizer state only the re-fit can rebuild
+        from photon_tpu.chaos import crash_point
+
+        crash_point("mid-fit", ins.server_round, self.node_id)
         fit_metrics = self.trainer.fit(
             loader, ins.local_steps, log_every=cfg.train.log_interval
         )

@@ -40,9 +40,20 @@ class Driver:
         """→ (node_id, msg_id, reply). Raises TimeoutError."""
         raise NotImplementedError
 
-    def broadcast(self, msg: Any, timeout: float = 300.0) -> dict[str, Ack]:
+    def hello_stats(self) -> dict[str, dict]:
+        """Node-reported supervisor stats (``{"reconnects", "backoff_s"}``
+        per node id) from the latest registration. TCP nodes report real
+        redial backoff; the multiprocess driver reports respawn counts with
+        zero backoff (a pipe respawn is immediate); in-process nodes never
+        leave."""
+        return {}
+
+    def broadcast(self, msg: Any, timeout: float = 300.0, on_stale=None) -> dict[str, Ack]:
         """Fan out one message to every node, wait for all acks (reference:
-        ``broadcast_utils.py:169-188``)."""
+        ``broadcast_utils.py:169-188``). A reply with an unknown mid is a
+        stale drain (e.g. a late FitRes from last round's timed-out cid) —
+        it is handed to ``on_stale`` so its transport segment can be freed
+        instead of silently leaking."""
         pending = {self.send(nid, msg): nid for nid in self.node_ids()}
         acks: dict[str, Ack] = {}
         deadline = time.monotonic() + timeout
@@ -54,6 +65,8 @@ class Driver:
             if mid in pending:
                 del pending[mid]
                 acks[nid] = reply if isinstance(reply, Ack) else Ack(ok=True, node_id=nid)
+            elif on_stale is not None:
+                on_stale(reply)
         return acks
 
     def shutdown(self) -> None:
@@ -103,6 +116,10 @@ class MultiprocessDriver(Driver):
         self._ctx = mp.get_context("spawn")  # fresh JAX in children
         self._nodes: dict[str, tuple[Any, Any]] = {}  # node_id -> (process, conn)
         self._inflight: dict[str, list[int]] = {}
+        self._respawns: dict[str, int] = {}
+        # replies synthesized for the 2nd..nth in-flight request of a dead
+        # node (the first returns immediately); drained before the pipes
+        self._dead_letters: list[tuple[str, int, Any]] = []
         for i in range(n_nodes):
             self._start(f"node{i}")
 
@@ -124,14 +141,38 @@ class MultiprocessDriver(Driver):
 
     def send(self, node_id: str, msg: Any) -> int:
         mid = next(self._mid)
-        proc, conn = self._nodes[node_id]
-        conn.send(Envelope(msg, mid))
+        entry = self._nodes.get(node_id)
+        if entry is None:
+            # node removed (restart_dead=False) but a caller still holds its
+            # id: synthesize a dead-node reply instead of KeyError-ing the
+            # round loop (mirrors TcpServerDriver.send)
+            self._dead_letters.append(
+                (node_id, mid, Ack(ok=False, detail="node died", node_id=node_id))
+            )
+            return mid
+        proc, conn = entry
+        try:
+            conn.send(Envelope(msg, mid))
+        except (OSError, ValueError):
+            # broken pipe with no reader: the node died while IDLE (nothing
+            # in flight, so recv_any never polled its pipe to hit the
+            # EOF-respawn path). Respawn it HERE — otherwise the zombie
+            # stays registered and every future send dead-letters, bleeding
+            # the failure budget dry — and fail this message now rather
+            # than letting recv_any wait on a silent pipe.
+            self._respawn(node_id)
+            self._dead_letters.append(
+                (node_id, mid, Ack(ok=False, detail="node died", node_id=node_id))
+            )
+            return mid
         self._inflight[node_id].append(mid)
         return mid
 
     def recv_any(self, timeout: float | None = None) -> tuple[str, int, Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
+            if self._dead_letters:
+                return self._dead_letters.pop(0)
             conns = {conn: nid for nid, (proc, conn) in self._nodes.items() if self._inflight[nid]}
             if not conns:
                 raise TimeoutError("recv_any: nothing in flight")
@@ -144,13 +185,19 @@ class MultiprocessDriver(Driver):
                 try:
                     env: Envelope = conn.recv()
                 except (EOFError, OSError):
-                    # dead node: synthesize error replies for everything in
-                    # flight there, then restart it (reference:
-                    # ``node_manager_app.py:326-351`` dead-worker handling)
+                    # dead node: synthesize error replies for EVERYTHING in
+                    # flight there (first returned now, rest as dead letters
+                    # — one timeout per orphan would stall the window), then
+                    # restart it (reference: ``node_manager_app.py:326-351``
+                    # dead-worker handling)
                     mids = self._inflight[nid]
                     self._inflight[nid] = []
                     self._respawn(nid)
                     if mids:
+                        for mid in mids[1:]:
+                            self._dead_letters.append(
+                                (nid, mid, Ack(ok=False, detail="node died", node_id=nid))
+                            )
                         return (
                             nid,
                             mids[0],
@@ -170,10 +217,17 @@ class MultiprocessDriver(Driver):
             proc.terminate()
         proc.join(timeout=10)
         if self.restart_dead:
+            self._respawns[node_id] = self._respawns.get(node_id, 0) + 1
             self._start(node_id)
         else:
             del self._nodes[node_id]
             del self._inflight[node_id]
+
+    def hello_stats(self) -> dict[str, dict]:
+        return {
+            nid: {"reconnects": n, "backoff_s": 0.0}
+            for nid, n in self._respawns.items()
+        }
 
     def shutdown(self) -> None:
         for nid, (proc, conn) in list(self._nodes.items()):

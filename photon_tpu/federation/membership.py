@@ -1,0 +1,263 @@
+"""Elastic node membership: liveness state machine + reconnect backoff.
+
+Reference behavior (SURVEY "Failure detection / elastic recovery"): the
+federation survives unreliable participants — failed tasks are re-queued,
+workers restart, a per-round failure budget absorbs the rest. What the
+reference leaves implicit (Flower's SuperLink keeps the registration open)
+is made explicit here:
+
+- :class:`LivenessTracker` (server side): a ping sweep between rounds moves
+  every node through ``live → suspect → dead``; a dead node whose id
+  reappears in the driver registry (TCP re-HELLO, multiprocess respawn) is
+  *readmitted* — it rejoins the scheduling rotation and the server re-sends
+  the current round's broadcast, instead of the node staying out of rotation
+  for the rest of the run.
+- :class:`ReconnectPolicy` (node side): jittered exponential backoff for the
+  redial supervisor in ``tcp.run_node``. Deterministic under a seeded rng
+  and an injected clock, so backoff *timing* is unit-testable.
+
+KPIs recorded into the round metrics by :class:`ServerApp`:
+``server/nodes_live``, ``server/nodes_suspect``, ``server/nodes_dead``,
+``server/nodes_readmitted`` (this round), ``server/reconnect_backoff_s``
+(cumulative node-reported redial backoff, from the HELLO stats).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Iterable
+
+from photon_tpu.federation.messages import Ack, Query
+
+LIVE = "live"
+SUSPECT = "suspect"
+DEAD = "dead"
+
+
+@dataclasses.dataclass
+class ReconnectPolicy:
+    """``delay(k) = min(max_s, base_s · 2^k) · (1 ± jitter)``.
+
+    ``rng`` needs only ``.random()``; inject a seeded one for determinism.
+    ``max_attempts`` bounds *consecutive* failed dials (0 = unlimited) — a
+    successful dial resets the attempt counter.
+    """
+
+    base_s: float = 0.5
+    max_s: float = 30.0
+    jitter: float = 0.25
+    max_attempts: int = 0
+    rng: object = None  # .random() in [0,1); default = module random
+
+    @classmethod
+    def from_config(cls, mem, rng=None) -> "ReconnectPolicy":
+        return cls(
+            base_s=mem.reconnect_backoff_base_s,
+            max_s=mem.reconnect_backoff_max_s,
+            jitter=mem.reconnect_backoff_jitter,
+            max_attempts=mem.reconnect_max_attempts,
+            rng=rng,
+        )
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before dial ``attempt`` (0-based). The exponent is
+        clamped so unlimited-retry supervisors can't OverflowError after
+        ~1024 consecutive failed dials (2.0**1024 is out of float range)."""
+        raw = min(self.max_s, self.base_s * (2.0 ** min(max(0, attempt), 63)))
+        if not self.jitter:
+            return raw
+        rng = self.rng
+        if rng is None:
+            import random as _random
+
+            rng = _random
+        return raw * (1.0 + self.jitter * (2.0 * rng.random() - 1.0))
+
+    def exhausted(self, attempt: int) -> bool:
+        return self.max_attempts > 0 and attempt >= self.max_attempts
+
+
+@dataclasses.dataclass
+class NodeHealth:
+    state: str = LIVE
+    misses: int = 0
+    readmissions: int = 0
+    # the id has been observed GONE from the driver registry since it was
+    # last live — the precondition for presence-based readmission (a wedged
+    # node whose socket stays open must not oscillate dead→readmitted)
+    absent: bool = False
+
+
+class LivenessTracker:
+    """Server-side liveness bookkeeping over a :class:`Driver`.
+
+    The tracker never talks to sockets itself — it pings through the driver
+    interface, so the same machine covers in-process, multiprocess, and TCP
+    topologies. A node id the tracker has seen but the driver no longer
+    lists counts as a miss exactly like an unanswered ping.
+    """
+
+    def __init__(
+        self,
+        suspect_after_misses: int = 1,
+        dead_after_misses: int = 2,
+        ping_timeout_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.suspect_after = suspect_after_misses
+        self.dead_after = dead_after_misses
+        self.ping_timeout_s = ping_timeout_s
+        self.clock = clock
+        self.nodes: dict[str, NodeHealth] = {}
+        self.readmitted_total = 0
+        self._readmitted_round = 0
+
+    # -- state transitions ----------------------------------------------
+    def observe_alive(self, nid: str) -> None:
+        h = self.nodes.setdefault(nid, NodeHealth())
+        if h.state == DEAD:
+            self._readmit(h)
+        h.state = LIVE
+        h.misses = 0
+
+    def observe_miss(self, nid: str) -> None:
+        h = self.nodes.setdefault(nid, NodeHealth())
+        h.misses += 1
+        if h.misses >= self.dead_after:
+            h.state = DEAD
+        elif h.misses >= self.suspect_after:
+            h.state = SUSPECT
+
+    def touch(self, nid: str) -> None:
+        """Start tracking an id (mid-round new join) WITHOUT the absence
+        bookkeeping of :meth:`register_present` — passing a single id there
+        would flag every other tracked node absent and arm the false
+        readmission the ``absent`` invariant exists to prevent."""
+        self.nodes.setdefault(nid, NodeHealth())
+
+    def note_readmitted(self, nid: str) -> None:
+        """Rejoin observed by the scheduler (sliding window): a node died
+        mid-round and came back (respawn / re-HELLO), got the broadcast
+        re-sent, and is back in rotation. Always counts — the scheduler sees
+        deaths (EOF dead-letters) faster than the ping sweep moves states,
+        so the tracker may still say LIVE."""
+        h = self.nodes.setdefault(nid, NodeHealth())
+        self._readmit(h)
+        h.state = LIVE
+        h.misses = 0
+
+    def _readmit(self, h: NodeHealth) -> None:
+        h.readmissions += 1
+        self.readmitted_total += 1
+        self._readmitted_round += 1
+
+    def register_present(self, ids: Iterable[str]) -> list[str]:
+        """Record the driver's current registry; a previously-dead id that
+        LEFT the registry and reappears is readmitted. Returns the
+        readmitted ids. Cheap (no pings) — the round loop calls it even on
+        sweep-skipped rounds so the liveness KPIs always reflect the real
+        registry.
+
+        Mere continued presence is NOT a reappearance: a wedged node whose
+        socket stays open goes dead and STAYS dead until it either actually
+        re-registers (absent → present) or answers a ping
+        (:meth:`observe_alive`)."""
+        id_set = set(ids)
+        for nid in set(self.nodes) - id_set:
+            self.nodes[nid].absent = True
+        readmitted: list[str] = []
+        for nid in id_set:
+            h = self.nodes.setdefault(nid, NodeHealth())
+            if h.state == DEAD and h.absent:
+                self._readmit(h)
+                h.state = LIVE
+                h.misses = 0
+                readmitted.append(nid)
+            h.absent = False
+        return readmitted
+
+    def counts(self) -> dict[str, int]:
+        out = {LIVE: 0, SUSPECT: 0, DEAD: 0}
+        for h in self.nodes.values():
+            out[h.state] += 1
+        return out
+
+    # -- the sweep -------------------------------------------------------
+    def sweep(self, driver, on_stale: Callable[[object], None] | None = None) -> list[str]:
+        """Ping every registered node; returns the ids readmitted by this
+        sweep. Runs between rounds, when the window has nothing in flight —
+        any non-ping reply that drains here is a stale late reply from a
+        quarantined node and is handed to ``on_stale`` (the server frees
+        transport segments there so late FitRes can't leak shm/objects).
+        """
+        present = list(driver.node_ids())
+        readmitted = self.register_present(present)
+        # known-but-gone ids miss without a ping (TCP drops dead nodes from
+        # the registry entirely; pinging them would only synthesize noise)
+        pending = {driver.send(nid, Query("ping")): nid for nid in present}
+        deadline = self.clock() + self.ping_timeout_s
+        while pending:
+            left = deadline - self.clock()
+            if left <= 0:
+                break
+            try:
+                nid, mid, reply = driver.recv_any(timeout=left)
+            except TimeoutError:
+                break
+            if mid not in pending:
+                if on_stale is not None:
+                    on_stale(reply)
+                continue
+            pnid = pending.pop(mid)
+            if isinstance(reply, Ack) and reply.ok:
+                # an answered ping readmits a dead node even if its id never
+                # left the registry (multiprocess respawns keep the id)
+                if self.nodes.setdefault(pnid, NodeHealth()).state == DEAD:
+                    readmitted.append(pnid)
+                self.observe_alive(pnid)
+            else:
+                # dead-letter ack ("node died") or an error reply
+                self.observe_miss(pnid)
+        for nid in pending.values():
+            self.observe_miss(nid)
+        for nid in set(self.nodes) - set(present):
+            self.observe_miss(nid)
+        return readmitted
+
+    # -- round metrics ---------------------------------------------------
+    def round_metrics(self, hello_backoff_s: float = 0.0) -> dict[str, float]:
+        """Per-round KPI snapshot; resets the per-round readmission count."""
+        from photon_tpu.utils.profiling import (
+            NODES_DEAD,
+            NODES_LIVE,
+            NODES_READMITTED,
+            NODES_SUSPECT,
+            RECONNECT_BACKOFF_S,
+        )
+
+        c = self.counts()
+        out = {
+            NODES_LIVE: float(c[LIVE]),
+            NODES_SUSPECT: float(c[SUSPECT]),
+            NODES_DEAD: float(c[DEAD]),
+            NODES_READMITTED: float(self._readmitted_round),
+            RECONNECT_BACKOFF_S: float(hello_backoff_s),
+        }
+        self._readmitted_round = 0
+        return out
+
+
+def hello_backoff_total(hello_stats: dict[str, dict] | None) -> float:
+    """Sum of node-reported cumulative redial backoff seconds (from the
+    HELLO payloads the TCP driver records; empty for other drivers)."""
+    if not hello_stats:
+        return 0.0
+    return float(sum(float(s.get("backoff_s", 0.0)) for s in hello_stats.values()))
+
+
+def iter_new_nodes(current: Iterable[str], tracked: Iterable[str]) -> list[str]:
+    """Node ids present in the driver but unknown to the scheduler's
+    bookkeeping — mid-round joins/readmissions."""
+    tracked_set = set(tracked)
+    return [nid for nid in current if nid not in tracked_set]

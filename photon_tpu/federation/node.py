@@ -16,6 +16,7 @@ from __future__ import annotations
 import traceback
 from typing import Any, Callable
 
+from photon_tpu import chaos
 from photon_tpu.config.schema import Config
 from photon_tpu.federation.client_runtime import ClientRuntime
 from photon_tpu.federation.messages import (
@@ -24,6 +25,7 @@ from photon_tpu.federation.messages import (
     Envelope,
     EvaluateIns,
     FitIns,
+    FitRes,
     Query,
 )
 from photon_tpu.federation.transport import ParamTransport
@@ -54,6 +56,7 @@ class NodeAgent:
     # -- dispatch --------------------------------------------------------
     def handle(self, msg: Any) -> Any:
         if isinstance(msg, FitIns):
+            chaos.crash_point("pre-fit", msg.server_round, self.node_id)
             return [self.runtime.fit(msg, cid) for cid in msg.cids]
         if isinstance(msg, EvaluateIns):
             return [self.runtime.evaluate(msg, cid) for cid in msg.cids]
@@ -86,13 +89,37 @@ class NodeAgent:
         return Ack(ok=False, detail=f"unknown query {q.action!r}", node_id=self.node_id)
 
     # -- serving loop (child process entry) ------------------------------
-    def serve(self, conn) -> None:
-        """Blocking loop over a Connection-like object with send/recv."""
+    def serve(self, conn) -> bool:
+        """Blocking loop over a Connection-like object with send/recv.
+
+        Returns True after a clean ``shutdown`` query, False when the peer
+        vanished (EOF / corrupt frame) — the distinction is what lets the
+        TCP supervisor (``tcp.run_node``) redial on connection loss instead
+        of mistaking it for an orderly exit.
+
+        Requests are deduplicated by ``msg_id`` (driver mids are unique
+        monotonic counters): a chaos-duplicated / network-repeated FitIns
+        must not run the fit twice — the second run would double-advance
+        per-cid loader/optimizer state and silently skip training data."""
+        import pickle
+        from collections import deque
+
+        recent: deque[int] = deque(maxlen=256)
+        recent_set: set[int] = set()
         while True:
             try:
                 env: Envelope = conn.recv()
-            except EOFError:
-                break
+            except (EOFError, pickle.UnpicklingError):
+                # an unpicklable frame (CRC-colliding corruption, protocol
+                # mismatch) is a broken stream like any EOF: hand control
+                # back so the supervisor redials instead of dying for good
+                return False
+            if env.msg_id in recent_set:
+                continue  # duplicate delivery: the first reply stands
+            if len(recent) == recent.maxlen:
+                recent_set.discard(recent[0])
+            recent.append(env.msg_id)
+            recent_set.add(env.msg_id)
             try:
                 reply = self.handle(env.msg)
             except Exception as e:  # noqa: BLE001 — never kill the loop silently
@@ -101,9 +128,16 @@ class NodeAgent:
                     detail=f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
                     node_id=self.node_id,
                 )
+            if isinstance(reply, list) and any(isinstance(r, FitRes) for r in reply):
+                # work done, result not yet on the wire — the nastiest crash
+                # window (the server must charge the cid to its budget AND
+                # the rejoined node must not double-report)
+                chaos.crash_point(
+                    "pre-reply", getattr(env.msg, "server_round", 0), self.node_id
+                )
             conn.send(Envelope(reply, env.msg_id))
             if isinstance(env.msg, Query) and env.msg.action == "shutdown":
-                break
+                return True
 
 
 def node_process_main(cfg_json: str, node_id: str, conn, platform: str | None, n_cpu_devices: int) -> None:
@@ -120,6 +154,7 @@ def node_process_main(cfg_json: str, node_id: str, conn, platform: str | None, n
             set_cpu_device_count(n_cpu_devices)
 
     cfg = Config.from_json(cfg_json)
+    chaos.install(cfg.photon.chaos, scope=node_id)
     store = None
     if cfg.photon.comm_stack.objstore or cfg.photon.checkpoint:
         from photon_tpu.checkpoint.store import FileStore

@@ -28,10 +28,12 @@ from typing import Callable, Iterator
 
 import numpy as np
 
+from photon_tpu import chaos
 from photon_tpu.checkpoint.server import ServerCheckpointManager
 from photon_tpu.codec import ParamsMetadata
 from photon_tpu.config.schema import Config
 from photon_tpu.federation.driver import Driver
+from photon_tpu.federation.membership import LivenessTracker, hello_backoff_total
 from photon_tpu.federation.messages import (
     Ack,
     Broadcast,
@@ -109,6 +111,35 @@ class ServerApp:
         FitRoundConfig.from_dict(cfg.fl.fit_config)
         EvaluateRoundConfig.from_dict(cfg.fl.eval_config)
         self.gns = GradientNoiseScale()
+        # elastic membership: ping-sweep liveness between rounds + mid-round
+        # readmission in the sliding window (ISSUE 3 tentpole); the chaos
+        # injector installs process-globally (None when chaos is off, which
+        # also clears any injector a previous config left behind)
+        mem = cfg.photon.membership
+        self.membership = LivenessTracker(
+            suspect_after_misses=mem.suspect_after_misses,
+            dead_after_misses=mem.dead_after_misses,
+            ping_timeout_s=mem.ping_timeout_s,
+        )
+        crash_fn = None
+        if cfg.photon.chaos.enabled and cfg.photon.chaos.crash_phase:
+            from photon_tpu.federation.driver import InProcessDriver
+
+            if isinstance(driver, InProcessDriver):
+                # in-process nodes ARE the server process: a real crash here
+                # would os._exit the whole run with no budget/respawn story.
+                # Neuter crash injection (the other fault sites still fire);
+                # process-kill scenarios need --multiprocess or TCP nodes.
+                import warnings
+
+                warnings.warn(
+                    "chaos.crash_phase with the in-process driver would kill "
+                    "the server itself — crash injection disabled (use the "
+                    "multiprocess or TCP driver for kill scenarios)",
+                    stacklevel=2,
+                )
+                crash_fn = lambda code: None  # noqa: E731
+        chaos.install(cfg.photon.chaos, scope="server", crash_fn=crash_fn)
         self.server_steps_cumulative = 0
         self.client_states: dict[int, dict] = {}
         self.start_round = 1
@@ -221,8 +252,15 @@ class ServerApp:
         # client results (w_new − w_global) decode against the right arrays
         self.transport.set_reference(self.strategy.current_parameters)
         msg = Broadcast(server_round, ptr)
-        acks = self.driver.broadcast(msg)
-        bad = [nid for nid, a in acks.items() if not a.ok]
+        acks = self.driver.broadcast(msg, on_stale=self._free_stale_reply)
+        # a node dying AT broadcast time is an elasticity event, not a fatal
+        # error: it leaves the registry (TCP) or respawns paramless
+        # (multiprocess) and the rejoin scan re-broadcasts when it returns.
+        # Only a LIVE node rejecting the payload is a real failure.
+        bad = [
+            nid for nid, a in acks.items()
+            if not a.ok and "node died" not in (a.detail or "")
+        ]
         if bad:
             raise RuntimeError(f"broadcast failed on nodes {bad}: {[acks[n].detail for n in bad]}")
         # free the PREVIOUS round's segment only now: nodes have copied the
@@ -241,6 +279,35 @@ class ServerApp:
             self._last_broadcast = None
         self.transport.cleanup()
         self.host_pool.close()
+
+    def _free_stale_reply(self, reply) -> None:
+        """Free transport segments carried by a late/stale reply (a FitRes
+        arriving after its cid was charged to the budget, or draining during
+        the between-rounds ping sweep) so it can't leak shm/objects."""
+        for res in (reply if isinstance(reply, list) else [reply]):
+            ptr = getattr(res, "params", None)
+            if ptr is not None:
+                self.transport.free(ptr)
+
+    def _membership_round_start(self, server_round: int) -> None:
+        """Between-rounds liveness maintenance: register the driver's current
+        registry (readmitting reappeared ids) and, on sweep rounds, drive the
+        ping sweep that moves silent nodes through suspect → dead."""
+        mem = self.cfg.photon.membership
+        if (
+            mem.enabled
+            and mem.ping_interval_rounds
+            and server_round % mem.ping_interval_rounds == 0
+        ):
+            # sweep performs the register_present pass itself
+            self.membership.sweep(self.driver, on_stale=self._free_stale_reply)
+        else:
+            self.membership.register_present(self.driver.node_ids())
+
+    def _membership_metrics(self) -> dict[str, float]:
+        return self.membership.round_metrics(
+            hello_backoff_s=hello_backoff_total(self.driver.hello_stats())
+        )
 
     def _sliding_window(
         self,
@@ -261,8 +328,40 @@ class ServerApp:
         # rotation until that stale reply drains (else the next cid lands on
         # a wedged node and times out too, cascading into the budget)
         suspect: dict[int, str] = {}
+        # nodes written off as wedged-for-good after a full extra drain
+        # window: kept out of the elastic-rejoin scan below until their
+        # stale reply finally drains (proof they recovered)
+        wedged: set[str] = set()
+        # mids already consumed this window: a chaos-duplicated reply frame
+        # carries the SAME ParamPointer as the copy the aggregation is
+        # decoding — it must be dropped, never "freed" out from under the
+        # decode-ahead pipeline
+        consumed: set[int] = set()
 
         while queue or inflight:
+            # elastic membership: a node id the driver lists but no
+            # scheduling structure tracks just (re)joined mid-round — a TCP
+            # re-HELLO after crash/redial, or a brand-new registration. It
+            # has no round params, so re-send the current broadcast (its ack
+            # drains through the stale-mid guard; socket ordering puts it
+            # before any FitIns we schedule next) and put it in rotation
+            # (generalizes the respawn re-send below to every join path).
+            tracked = set(free)
+            tracked.update(n for n, _ in inflight.values())
+            tracked.update(suspect.values())
+            tracked.update(wedged)
+            for nid in self.driver.node_ids():
+                if nid not in tracked:
+                    if self._last_broadcast is not None:
+                        self.driver.send(nid, self._last_broadcast)
+                    free.append(nid)
+                    if nid in self.membership.nodes:
+                        # a KNOWN node came back — that's a readmission; a
+                        # brand-new registration joining mid-round is
+                        # scale-up, not churn, and must not inflate the KPI
+                        self.membership.note_readmitted(nid)
+                    else:
+                        self.membership.touch(nid)
             while queue and free:
                 nid, cid = free.popleft(), queue.popleft()
                 mid = self.driver.send(nid, make_ins([cid]))
@@ -294,6 +393,9 @@ class ServerApp:
                     # this timeout was a pure drain-wait on quarantined nodes
                     # that still haven't replied after a whole extra window —
                     # consider them wedged for good and stop waiting on them
+                    # (the `wedged` set keeps the rejoin scan from cycling
+                    # them straight back into rotation)
+                    wedged.update(suspect.values())
                     suspect.clear()
                 inflight.clear()
                 if not free and queue and not suspect:
@@ -302,37 +404,70 @@ class ServerApp:
                     queue.clear()
                 continue
             if mid not in inflight:
+                if mid in consumed:
+                    # duplicate delivery of an already-processed reply: the
+                    # first copy owns the segment lifecycle — drop, don't free
+                    continue
                 # stale correlation id (e.g. a FitRes arriving after its cid
                 # was charged to the budget on timeout): free any transport
                 # segment it carries so late replies don't leak shm/objects,
-                # and return the now-drained node to rotation
-                for res in (reply if isinstance(reply, list) else [reply]):
-                    ptr = getattr(res, "params", None)
-                    if ptr is not None:
-                        self.transport.free(ptr)
-                nid = suspect.pop(mid, None)
-                if nid is not None and nid in self.driver.node_ids():
-                    free.append(nid)
+                # and return the now-drained node to rotation. Mark the mid
+                # consumed FIRST — a chaos-duplicated copy of this same
+                # frame must not free the tag a second time (the retried
+                # cid may have rewritten it by then)
+                consumed.add(mid)
+                self._free_stale_reply(reply)
+                drained = suspect.pop(mid, None)
+                if drained is None and nid in wedged:
+                    # a written-off node finally answered: it recovered
+                    wedged.discard(nid)
+                    drained = nid
+                if drained is not None and drained in self.driver.node_ids():
+                    stale_died = any(
+                        isinstance(r, Ack) and "node died" in (r.detail or "")
+                        for r in (reply if isinstance(reply, list) else [reply])
+                    )
+                    if stale_died and self._last_broadcast is not None:
+                        # the drain was a re-HELLO dead-letter, not a real
+                        # late reply: the restarted process has no round
+                        # params — re-send before the next cid lands there
+                        self.driver.send(drained, self._last_broadcast)
+                        self.membership.note_readmitted(drained)
+                    free.append(drained)
                 continue
             _, cid = inflight.pop(mid)
+            consumed.add(mid)
             replies = reply if isinstance(reply, list) else [reply]
             node_died = any(
                 isinstance(res, Ack) and "node died" in (res.detail or "") for res in replies
             )
             if node_died and nid in self.driver.node_ids():
-                # respawned under the same id (MultiprocessDriver): it has no
-                # round params — re-send the broadcast before any retry lands
-                # there (its ack is drained by the `mid not in inflight` guard
-                # above), then keep scheduling onto it
+                # respawned under the same id (MultiprocessDriver restart, or
+                # a TCP re-HELLO whose stale requests were dead-lettered): it
+                # has no round params — re-send the broadcast before any
+                # retry lands there (its ack is drained by the `mid not in
+                # inflight` guard above), then keep scheduling onto it
                 if self._last_broadcast is not None:
                     self.driver.send(nid, self._last_broadcast)
                 free.append(nid)
+                self.membership.note_readmitted(nid)
             elif not node_died:
                 free.append(nid)
             # else: node is gone for good (TCP driver) — drop it from rotation
             for res in replies:
                 err = res.detail if isinstance(res, Ack) else getattr(res, "error", None)
                 if isinstance(res, Ack) or err:
+                    if (
+                        err
+                        and "no parameters" in err
+                        and nid in self.driver.node_ids()
+                        and self._last_broadcast is not None
+                    ):
+                        # an externally-restarted node re-HELLO'd under its
+                        # old id: the socket came back but the process lost
+                        # the round broadcast — re-send it so the next cid
+                        # scheduled there can actually run
+                        self.driver.send(nid, self._last_broadcast)
                     if cid not in retried and len(self.driver.node_ids()) > 0:
                         retried.add(cid)
                         queue.append(cid)
@@ -469,16 +604,23 @@ class ServerApp:
             if cfg.photon.refresh_period and rnd > 1 and (rnd - 1) % cfg.photon.refresh_period == 0:
                 from photon_tpu.federation.messages import Query
 
-                self.driver.broadcast(Query("refresh"))
+                self.driver.broadcast(Query("refresh"), on_stale=self._free_stale_reply)
+            # liveness sweep BEFORE the broadcast: readmitted nodes are back
+            # in the registry when broadcast_parameters fans out, so a
+            # crash-and-rejoin between rounds needs no special re-send
+            self._membership_round_start(rnd)
             t_pre = self.broadcast_parameters(rnd)
             try:
                 metrics = self.fit_round(rnd)
             except TooManyFailuresError:
                 if not cfg.fl.ignore_failed_rounds:
                     raise
-                self.history.record(rnd, {"server/round_failed": 1.0})
+                failed = {"server/round_failed": 1.0}
+                failed.update(self._membership_metrics())
+                self.history.record(rnd, failed)
                 continue
             metrics["server/broadcast_pre_time"] = t_pre
+            metrics.update(self._membership_metrics())
 
             if cfg.fl.eval_interval_rounds and rnd % cfg.fl.eval_interval_rounds == 0:
                 t_post = self.broadcast_parameters(rnd)

@@ -34,19 +34,33 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Any
 
+from photon_tpu import chaos
 from photon_tpu.federation.driver import Driver
+from photon_tpu.federation.membership import ReconnectPolicy
 from photon_tpu.federation.messages import Ack, Envelope, Query
 
-_LEN = struct.Struct("<Q")
+# frame header: payload length + CRC32 of the payload. The checksum exists
+# for the chaos corruption injector and for real bit-rot alike: a corrupt
+# frame must surface as a broken CONNECTION (stream framing is unusable
+# after it), never as a silently unpickled wrong object.
+_FRAME = struct.Struct("<QI")
 HELLO_KIND = "__hello__"
 
 
+class CorruptFrameError(EOFError):
+    """Frame failed its CRC32. Subclasses EOFError deliberately: every
+    caller already tears the connection down on EOF, which is the only safe
+    response once the byte stream can't be trusted."""
+
+
 class SocketConn:
-    """Length-prefixed pickle framing over a stream socket, Connection-like
-    (``send``/``recv``/``close``) so :meth:`NodeAgent.serve` runs unchanged."""
+    """Length+CRC-prefixed pickle framing over a stream socket,
+    Connection-like (``send``/``recv``/``close``) so :meth:`NodeAgent.serve`
+    runs unchanged."""
 
     def __init__(self, sock: socket.socket) -> None:
         self.sock = sock
@@ -59,8 +73,26 @@ class SocketConn:
 
     def send(self, obj: Any) -> None:
         data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        header = _FRAME.pack(len(data), zlib.crc32(data))
+        repeat = 1
+        inj = chaos.active()
+        if inj is not None and isinstance(obj, Envelope):
+            # chaos targets Envelopes only: HELLO/registration frames stay
+            # exempt so membership control can't be wedged by the injector
+            plan = inj.tcp_plan()
+            if plan.drop:
+                return
+            if plan.delay_s:
+                time.sleep(plan.delay_s)
+            if plan.corrupt:
+                # flip a payload bit AFTER the CRC was computed — the
+                # receiver's checksum is what must catch it
+                data = inj.corrupt_bytes(data)
+            if plan.duplicate:
+                repeat = 2
         with self._wlock:
-            self.sock.sendall(_LEN.pack(len(data)) + data)
+            for _ in range(repeat):
+                self.sock.sendall(header + data)
 
     def _read_exact(self, n: int) -> bytes:
         buf = bytearray()
@@ -73,8 +105,11 @@ class SocketConn:
 
     def recv(self) -> Any:
         with self._rlock:
-            (n,) = _LEN.unpack(self._read_exact(_LEN.size))
-            return pickle.loads(self._read_exact(n))
+            n, crc = _FRAME.unpack(self._read_exact(_FRAME.size))
+            data = self._read_exact(n)
+        if zlib.crc32(data) != crc:
+            raise CorruptFrameError(f"frame CRC mismatch ({n} bytes)")
+        return pickle.loads(data)
 
     def close(self) -> None:
         try:
@@ -93,6 +128,9 @@ class TcpServerDriver(Driver):
         # replies synthesized for sends to dead/unknown nodes, drained by
         # recv_any before touching sockets
         self._dead_letters: deque[tuple[str, int, Ack]] = deque()
+        # node-reported supervisor stats from the latest HELLO
+        # ({"reconnects": int, "backoff_s": float} per node id)
+        self._hello_stats: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._mid = iter(range(1 << 62))
         self._listener = socket.create_server((host, port))
@@ -123,9 +161,30 @@ class TcpServerDriver(Driver):
             with self._lock:
                 old = self._nodes.get(node_id)
                 self._nodes[node_id] = conn
-                self._inflight.setdefault(node_id, [])
+                # requests in flight on the replaced socket are gone for
+                # good (the node restarted or lost the connection carrying
+                # them) — drain them as dead-letter failures NOW instead of
+                # letting the sliding window eat a full fit_timeout_s. The
+                # "node died" detail routes the scheduler through its
+                # rejoin path: re-broadcast, back into rotation.
+                stale = self._inflight.get(node_id, [])
+                self._inflight[node_id] = []
+                for mid in stale:
+                    self._dead_letters.append(
+                        (node_id, mid,
+                         Ack(ok=False, detail="node died: reconnected mid-request",
+                             node_id=node_id))
+                    )
+                self._hello_stats[node_id] = {
+                    "reconnects": int(hello.get("reconnects", 0)),
+                    "backoff_s": float(hello.get("backoff_s", 0.0)),
+                }
             if old is not None:
                 old.close()  # reconnection replaces the stale socket
+
+    def hello_stats(self) -> dict[str, dict]:
+        with self._lock:
+            return {nid: dict(s) for nid, s in self._hello_stats.items()}
 
     def wait_for_nodes(self, timeout: float = 300.0, poll: float = 0.2) -> None:
         """Block until ``expected_nodes`` registered (reference:
@@ -199,14 +258,33 @@ class TcpServerDriver(Driver):
                 try:
                     env: Envelope = conn.recv()
                 except (EOFError, OSError, pickle.UnpicklingError):
+                    # (CorruptFrameError lands here too, via EOFError: once a
+                    # frame fails its CRC the stream offset is untrusted and
+                    # the connection must die)
                     with self._lock:
-                        mids = self._inflight.get(nid, [])
-                        self._inflight[nid] = []
                         if self._nodes.get(nid) is conn:
+                            # genuinely dead: evict and fail everything it
+                            # still owed us
+                            mids = self._inflight.get(nid, [])
+                            self._inflight[nid] = []
                             del self._nodes[nid]
+                        else:
+                            # EOF on a STALE socket the accept loop already
+                            # replaced — the replacement's in-flight mids are
+                            # not ours to fail (they were dead-lettered at
+                            # re-HELLO time; new requests ride the new conn)
+                            mids = []
                     conn.close()
                     if mids:
-                        # dead node: synthesized failure, like MultiprocessDriver
+                        # dead node: synthesized failures, like
+                        # MultiprocessDriver; ALL in-flight mids drain (first
+                        # returned now, the rest as dead letters) so a multi-
+                        # request window never waits a timeout per orphan
+                        with self._lock:
+                            for mid in mids[1:]:
+                                self._dead_letters.append(
+                                    (nid, mid, Ack(ok=False, detail="node died", node_id=nid))
+                                )
                         return nid, mids[0], Ack(ok=False, detail="node died", node_id=nid)
                     continue
                 with self._lock:
@@ -242,17 +320,39 @@ class TcpServerDriver(Driver):
         with self._lock:
             self._nodes.clear()
             self._inflight.clear()
+            self._hello_stats.clear()
 
 
-def run_node(server_addr: str, node_id: str, cfg_json: str, retries: int = 30) -> None:
-    """Node-side: dial the server and serve the agent loop (reference:
-    ``flower-client-app`` pointed at DRIVER_API_ADDRESS)."""
+def run_node(
+    server_addr: str,
+    node_id: str,
+    cfg_json: str,
+    retries: int | None = None,
+    sleep=time.sleep,
+) -> None:
+    """Node-side supervisor: dial the server, serve the agent loop, and on
+    socket loss reconnect with jittered exponential backoff + re-HELLO
+    (reference: ``flower-client-app`` pointed at DRIVER_API_ADDRESS — whose
+    gRPC channel reconnects under the hood; here the supervision is
+    explicit and its backoff is config/test-visible).
+
+    Every HELLO carries the supervisor's cumulative stats
+    (``reconnects``/``backoff_s``); the server surfaces them as the
+    ``server/reconnect_backoff_s`` KPI. ``retries`` overrides
+    ``membership.reconnect_max_attempts`` and shares its contract:
+    ``0 = retry forever`` (NOT the pre-supervisor "fail immediately" —
+    callers wanting fail-fast pass 1). ``sleep`` is injectable for tests; a
+    clean ``shutdown`` query ends the loop.
+    """
+    import random as random_mod
+
     from photon_tpu.config.schema import Config
     from photon_tpu.federation.node import NodeAgent
     from photon_tpu.federation.transport import ParamTransport
 
     host, _, port = server_addr.rpartition(":")
     cfg = Config.from_json(cfg_json)
+    chaos.install(cfg.photon.chaos, scope=node_id)
 
     store = None
     if cfg.photon.comm_stack.objstore:
@@ -275,23 +375,58 @@ def run_node(server_addr: str, node_id: str, cfg_json: str, retries: int = 30) -
         def make_ckpt_mgr():
             return ClientCheckpointManager(store, cfg.run_uuid)
 
+    policy = ReconnectPolicy.from_config(
+        cfg.photon.membership,
+        rng=random_mod.Random(zlib.crc32(node_id.encode())),
+    )
+    if retries is not None:
+        policy.max_attempts = retries
     agent = NodeAgent(cfg, node_id, make_transport, make_ckpt_mgr=make_ckpt_mgr)
-    for attempt in range(retries):
+    attempt = 0  # consecutive failed dials; a successful dial resets it
+    reconnects = 0
+    backoff_total = 0.0
+    while True:
         try:
             sock = socket.create_connection((host, int(port)), timeout=10)
         except OSError:
-            time.sleep(min(2.0 * (attempt + 1), 10.0))
+            attempt += 1
+            if policy.exhausted(attempt):
+                raise ConnectionError(
+                    f"could not reach server at {server_addr} after {attempt} dials "
+                    f"({backoff_total:.1f}s total backoff)"
+                )
+            d = policy.delay(attempt - 1)
+            backoff_total += d
+            sleep(d)
             continue
+        attempt = 0
         conn = SocketConn(sock)
-        conn.send({"kind": HELLO_KIND, "node_id": node_id})
+        clean = False
         try:
-            agent.serve(conn)
-            return  # clean shutdown
-        except (EOFError, OSError):
-            continue  # server went away; retry dial
+            # the HELLO itself can hit a reset (server accepted via the
+            # listener backlog, then died): that is a connection loss like
+            # any other, not a supervisor crash
+            conn.send({
+                "kind": HELLO_KIND,
+                "node_id": node_id,
+                "reconnects": reconnects,
+                "backoff_s": backoff_total,
+            })
+            clean = agent.serve(conn)
+        except OSError:
+            clean = False  # send failed mid-reply: same as connection loss
         finally:
             conn.close()
-    raise ConnectionError(f"could not reach server at {server_addr}")
+        if clean:
+            return  # orderly shutdown query
+        # server went away (or a corrupt frame killed the stream): back
+        # off, then redial + re-HELLO. The server's accept loop replaces
+        # our stale registration and dead-letters anything it still had in
+        # flight on the old socket.
+        reconnects += 1
+        d = policy.delay(0)
+        backoff_total += d
+        sleep(d)
 
 
 def main(argv: list[str] | None = None) -> None:

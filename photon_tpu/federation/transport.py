@@ -69,6 +69,10 @@ class ParamTransport:
             raise ValueError("objstore transport needs a store")
         self.mode = mode
         self.store = store
+        if mode == "shm":
+            # reap temp segments a SIGKILLed writer left behind — a
+            # crash-and-rejoin node must not ratchet /dev/shm toward ENOSPC
+            shm.sweep_stale_tmp()
         self.codec = make_codec(compression)
         self.stats = WireStats()
         # shared bounded pool for the codec's per-layer encode/decode
@@ -127,7 +131,10 @@ class ParamTransport:
         if self.mode == "objstore":
             assert self.store is not None
             key = f"transport/{tag}.npz"
-            self.store.put(key, arrays_to_npz(metadata, arrays))
+            # durable=False: transport objects are deleted at round end —
+            # fsyncing a model-sized payload per client per round would put
+            # a disk flush on the hot path for zero crash-safety gain
+            self.store.put(key, arrays_to_npz(metadata, arrays), durable=False)
             self._owned.append(key)
             return ParamPointer("objstore", key, metadata.to_json())
         return ParamPointer("inline", "", metadata.to_json(), inline=[np.asarray(a) for a in arrays])

@@ -281,6 +281,38 @@ def unlink(name: str, missing_ok: bool = True) -> None:
             raise
 
 
+def sweep_stale_tmp() -> int:
+    """Unlink ``photon-*.tmp-<pid>`` temp segments whose writer pid is dead.
+
+    :func:`write_params`/:func:`write_blob` stage into a pid-suffixed temp
+    file and rename on commit; a node SIGKILLed mid-write leaks the temp
+    segment in ``/dev/shm`` forever (tmpfs pages pinned until reboot).
+    Called at :class:`ParamTransport` startup — by then the leaking pid is
+    either alive (leave its in-flight write alone) or gone (reap it).
+    """
+    n = 0
+    for p in SHM_DIR.glob("photon-*.tmp-*"):
+        pid_s = p.name.rpartition(".tmp-")[2]
+        if not pid_s.isdigit():
+            continue
+        pid = int(pid_s)
+        if pid == os.getpid():
+            continue  # our own in-flight write
+        try:
+            os.kill(pid, 0)
+            continue  # writer still alive: the rename may yet land
+        except ProcessLookupError:
+            pass  # dead writer: orphaned segment
+        except PermissionError:
+            continue  # pid exists under another uid
+        try:
+            p.unlink()
+            n += 1
+        except OSError:
+            pass
+    return n
+
+
 def cleanup_stale(prefix: str = "") -> int:
     """Remove leftover segments (reference: ``clean_stale_shared_memory`` /
     streaming-shm leak cleanup, ``clients/utils.py:655-673``)."""

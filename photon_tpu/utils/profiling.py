@@ -34,6 +34,19 @@ AGG_FOLD_TIME = "server/agg_fold_time"
 #: (round N's metrics carry round N-1's write; 0.0 until one completes)
 CKPT_ASYNC_WRITE_S = "server/ckpt_async_write_s"
 
+# Elastic-membership KPI names (ISSUE 3): recorded every round by ServerApp
+# from the LivenessTracker + the drivers' HELLO stats.
+#: nodes the liveness state machine currently counts as live
+NODES_LIVE = "server/nodes_live"
+#: nodes with missed pings, not yet declared dead
+NODES_SUSPECT = "server/nodes_suspect"
+#: nodes declared dead (out of rotation until they re-register)
+NODES_DEAD = "server/nodes_dead"
+#: readmissions THIS round (dead/crashed nodes back in rotation)
+NODES_READMITTED = "server/nodes_readmitted"
+#: cumulative node-reported redial backoff seconds (from HELLO payloads)
+RECONNECT_BACKOFF_S = "server/reconnect_backoff_s"
+
 
 @dataclasses.dataclass
 class WireStats:

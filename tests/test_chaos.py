@@ -1,22 +1,367 @@
-"""Elasticity soak: random client failures across many rounds.
+"""Chaos suite: the deterministic fault-injection subsystem
+(``photon_tpu/chaos``) and the elasticity it exists to prove.
 
 SURVEY §5 "Failure detection / elastic recovery": the reference recovers
 round-by-round (failed task re-queued, worker restarted, failure budget).
-The targeted failure tests cover each mechanism once; this soak drives the
-WHOLE loop through sustained, randomized chaos — a different client failing
-on its first attempt in every round, some rounds failing outright — and
-asserts the run still completes, aggregates every round from the surviving
-clients, and keeps training signal flowing (param norms finite, pseudo-grad
-norms > 0, cumulative steps advancing only for completed rounds).
+Here every failure mode is an injectable, seeded event: TCP envelope faults
+(drop/delay/duplicate/corrupt, caught by CRC32 framing), object-store faults
+(slow/partial/bit-flipped writes, caught by checkpoint checksums), and
+SIGKILL-equivalent node crashes at chosen phases. The soak at the bottom
+drives the whole loop through sustained randomized failures.
+
+Run the full suite with a fixed seed via ``make chaos``; the fast tests are
+tier-1 so injector plumbing can't rot.
 """
 
 import random
+import socket
+import time
 
 import numpy as np
 import pytest
 
-from photon_tpu.federation.messages import FitRes
+from photon_tpu import chaos
+from photon_tpu.config.schema import ChaosConfig
+from photon_tpu.federation.messages import Envelope, FitRes, Query
 from tests.test_federation import make_app, make_cfg
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    """Never leak a process-global injector into another test."""
+    yield
+    chaos.uninstall()
+
+
+def _chaos_cfg(**kw) -> ChaosConfig:
+    return ChaosConfig(enabled=True, seed=1234, **kw)
+
+
+# ---------------------------------------------------------------------------
+# injector unit tests (fast, tier-1 smoke)
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_chaos_is_a_noop():
+    assert chaos.active() is None
+    assert chaos.install(ChaosConfig()) is None  # enabled=False clears
+    assert chaos.install(None) is None
+    chaos.crash_point("mid-fit", 1, "node0")  # must not raise or exit
+
+
+def test_injector_schedule_is_deterministic():
+    cfg = _chaos_cfg(tcp_drop_p=0.3, tcp_delay_p=0.3, tcp_duplicate_p=0.3,
+                     tcp_corrupt_p=0.3)
+    a = chaos.FaultInjector(cfg, scope="node0")
+    b = chaos.FaultInjector(cfg, scope="node0")
+    plans_a = [a.tcp_plan() for _ in range(64)]
+    plans_b = [b.tcp_plan() for _ in range(64)]
+    assert plans_a == plans_b
+    assert a.counts == b.counts
+    # a different scope draws a different stream
+    c = chaos.FaultInjector(cfg, scope="node1")
+    assert [c.tcp_plan() for _ in range(64)] != plans_a
+
+
+def test_corrupt_bytes_flips_exactly_one_bit():
+    inj = chaos.FaultInjector(_chaos_cfg(), scope="x")
+    data = bytes(range(256))
+    out = inj.corrupt_bytes(data)
+    assert len(out) == len(data)
+    diff = [(x ^ y) for x, y in zip(data, out) if x != y]
+    assert len(diff) == 1 and bin(diff[0]).count("1") == 1
+
+
+def test_crash_point_matching_and_marker(tmp_path):
+    marker = tmp_path / "crashed"
+    crashes = []
+    cfg = _chaos_cfg(crash_phase="mid-fit", crash_round=2,
+                     crash_node_id="node1", crash_marker=str(marker))
+    chaos.install(cfg, scope="node1", crash_fn=crashes.append)
+    chaos.crash_point("pre-fit", 2, "node1")  # wrong phase
+    chaos.crash_point("mid-fit", 1, "node1")  # wrong round
+    chaos.crash_point("mid-fit", 2, "node0")  # wrong node
+    assert crashes == [] and not marker.exists()
+    chaos.crash_point("mid-fit", 2, "node1")
+    assert crashes == [137] and marker.exists()
+    chaos.crash_point("mid-fit", 2, "node1")  # marker disarms the repeat
+    assert crashes == [137]
+
+
+# ---------------------------------------------------------------------------
+# TCP envelope faults + CRC framing
+# ---------------------------------------------------------------------------
+
+
+def _pair():
+    from photon_tpu.federation.tcp import SocketConn
+
+    a, b = socket.socketpair()
+    return SocketConn(a), SocketConn(b)
+
+
+def test_tcp_corrupt_frame_detected_by_crc():
+    from photon_tpu.federation.tcp import CorruptFrameError
+
+    tx, rx = _pair()
+    chaos.install(_chaos_cfg(tcp_corrupt_p=1.0), scope="t")
+    tx.send(Envelope(Query("ping"), 1))
+    with pytest.raises(CorruptFrameError):
+        rx.recv()
+    # CorruptFrameError IS an EOFError: every existing teardown path applies
+    assert issubclass(CorruptFrameError, EOFError)
+    tx.close(); rx.close()
+
+
+def test_tcp_duplicate_and_drop():
+    tx, rx = _pair()
+    chaos.install(_chaos_cfg(tcp_duplicate_p=1.0), scope="t")
+    tx.send(Envelope(Query("ping"), 7))
+    first, second = rx.recv(), rx.recv()
+    assert first.msg_id == second.msg_id == 7
+
+    chaos.install(_chaos_cfg(tcp_drop_p=1.0), scope="t")
+    tx.send(Envelope(Query("ping"), 8))
+    rx.sock.settimeout(0.2)
+    with pytest.raises(OSError):  # nothing ever arrives
+        rx.recv()
+    tx.close(); rx.close()
+
+
+def test_tcp_chaos_exempts_non_envelopes():
+    """HELLO/registration frames must never be faulted — membership control
+    cannot be wedged by the injector."""
+    tx, rx = _pair()
+    chaos.install(_chaos_cfg(tcp_drop_p=1.0, tcp_corrupt_p=1.0), scope="t")
+    tx.send({"kind": "__hello__", "node_id": "n0"})
+    assert rx.recv()["node_id"] == "n0"
+    tx.close(); rx.close()
+
+
+def test_tcp_frames_unchanged_with_chaos_off():
+    tx, rx = _pair()
+    env = Envelope(Query("ping", {"k": 1}), 42)
+    tx.send(env)
+    got = rx.recv()
+    assert got.msg_id == 42 and got.msg.action == "ping"
+    tx.close(); rx.close()
+
+
+# ---------------------------------------------------------------------------
+# object-store faults
+# ---------------------------------------------------------------------------
+
+
+def test_store_bitflip_lands_corrupt_object(tmp_path):
+    from photon_tpu.checkpoint.store import FileStore
+
+    s = FileStore(tmp_path)
+    data = bytes(1000)
+    chaos.install(_chaos_cfg(store_bitflip_p=1.0), scope="srv")
+    s.put("obj.bin", data)
+    got = s.get("obj.bin")
+    assert len(got) == len(data) and got != data  # well-formed, wrong bytes
+
+
+def test_store_partial_write_never_lands(tmp_path):
+    from photon_tpu.checkpoint.store import FileStore
+
+    s = FileStore(tmp_path)
+    chaos.install(_chaos_cfg(store_partial_p=1.0), scope="srv")
+    s.put("obj.bin", b"x" * 100)
+    assert not s.exists("obj.bin")
+    assert s.list("") == []  # the leaked .tmp is not a listable object
+    leaked = [p for p in tmp_path.rglob("*") if ".tmp-" in p.name]
+    assert len(leaked) == 1  # the torn temp file is there for forensics
+
+
+def test_store_slow_write_still_correct(tmp_path):
+    from photon_tpu.checkpoint.store import FileStore
+
+    s = FileStore(tmp_path)
+    inj = chaos.install(_chaos_cfg(store_slow_p=1.0, store_slow_max_s=0.01), scope="srv")
+    s.put("obj.bin", b"payload")
+    assert s.get("obj.bin") == b"payload"
+    assert inj.counts["store_slow"] == 1
+
+
+def test_store_roundtrip_identical_with_chaos_off(tmp_path):
+    from photon_tpu.checkpoint.store import FileStore
+
+    s = FileStore(tmp_path)
+    data = np.random.default_rng(0).integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    s.put("obj.bin", data)
+    assert s.get("obj.bin") == data
+
+
+# ---------------------------------------------------------------------------
+# chaos → integrity end-to-end: corrupt checkpoint detected at resume
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_bitflip_checkpoint_skipped_at_resume(tmp_path):
+    """A chaos bit-flip during round-3's checkpoint write is caught by the
+    manifest checksums and resume falls back to round 2."""
+    from photon_tpu.checkpoint import FileStore, ServerCheckpointManager
+    from photon_tpu.codec import ParamsMetadata
+
+    store = FileStore(tmp_path)
+    mgr = ServerCheckpointManager(store, "run1")
+    arrays = [np.ones((8, 8), dtype=np.float32)]
+    meta = ParamsMetadata.from_ndarrays(["w"], arrays)
+    mgr.save_round(1, meta, arrays, {}, {"round": 1})
+    mgr.save_round(2, meta, arrays, {}, {"round": 2})
+    # round 3 writes under chaos: every object bit-flipped AFTER the
+    # manifest CRCs were computed over the true bytes
+    chaos.install(_chaos_cfg(store_bitflip_p=1.0), scope="srv")
+    mgr.save_round(3, meta, arrays, {}, {"round": 3})
+    chaos.uninstall()
+    assert not mgr.verify_round(3)
+    with pytest.warns(UserWarning, match="checksum"):
+        assert mgr.resolve_resume_round(-1) == 2
+
+
+# ---------------------------------------------------------------------------
+# crash-hook placement (recording crash_fn, no process exits)
+# ---------------------------------------------------------------------------
+
+
+def test_crash_hooks_fire_pre_and_mid_fit(tmp_path):
+    for phase in ("pre-fit", "mid-fit"):
+        cfg = make_cfg(tmp_path, n_rounds=1)
+        cfg.photon.chaos.enabled = True
+        cfg.photon.chaos.crash_phase = phase
+        cfg.photon.chaos.crash_round = 1
+        app = make_app(cfg, tmp_path)
+        recorded = []
+        # re-install over the ServerApp's default installation to swap in a
+        # recording crash_fn (in-process agents share the server's injector)
+        chaos.install(cfg.photon.chaos, scope="server", crash_fn=recorded.append)
+        app.run()
+        app.driver.shutdown()
+        assert recorded and set(recorded) == {137}, phase
+
+
+def test_serve_deduplicates_repeated_envelopes(tmp_path):
+    """A chaos-duplicated FitIns must not run the fit twice — the second
+    run would double-advance per-cid loader/optimizer state."""
+    from photon_tpu.federation import NodeAgent, ParamTransport
+    from photon_tpu.federation.messages import Query
+
+    cfg = make_cfg(tmp_path)
+    agent = NodeAgent(cfg, "node0", lambda: ParamTransport("inline"))
+    handled = []
+    orig = agent.handle
+    agent.handle = lambda msg: (handled.append(msg), orig(msg))[1]
+
+    class _StubConn:
+        def __init__(self, envs):
+            self.envs = list(envs)
+            self.sent = []
+
+        def recv(self):
+            if not self.envs:
+                raise EOFError
+            return self.envs.pop(0)
+
+        def send(self, obj):
+            self.sent.append(obj)
+
+    ping = Envelope(Query("ping"), 5)
+    conn = _StubConn([ping, ping, Envelope(Query("ping"), 6)])
+    agent.serve(conn)
+    agent.runtime.close()
+    assert len(handled) == 2  # mids 5 and 6 once each; the duplicate dropped
+    assert [e.msg_id for e in conn.sent] == [5, 6]
+
+
+def test_pre_reply_crash_hook_fires_in_serve(tmp_path):
+    """pre-reply is the serve-loop's window: work done, result not yet on
+    the wire. An error FitRes counts — the reply is what matters."""
+    from photon_tpu.federation import NodeAgent, ParamTransport
+    from photon_tpu.federation.messages import FitIns
+
+    cfg = make_cfg(tmp_path)
+    cfg.photon.chaos.enabled = True
+    cfg.photon.chaos.crash_phase = "pre-reply"
+    recorded = []
+    chaos.install(cfg.photon.chaos, scope="node0", crash_fn=recorded.append)
+    agent = NodeAgent(cfg, "node0", lambda: ParamTransport("inline"))
+
+    class _StubConn:
+        def __init__(self, envs):
+            self.envs = list(envs)
+            self.sent = []
+
+        def recv(self):
+            if not self.envs:
+                raise EOFError
+            return self.envs.pop(0)
+
+        def send(self, obj):
+            self.sent.append(obj)
+
+    # params=None with no prior broadcast → an error FitRes, cheaply
+    ins = FitIns(server_round=1, cids=[0], params=None, local_steps=1,
+                 server_steps_cumulative=0)
+    conn = _StubConn([Envelope(ins, 1)])
+    agent.serve(conn)
+    agent.runtime.close()
+    assert recorded == [137]
+    assert len(conn.sent) == 1  # the recording crash_fn returned; reply sent
+
+
+# ---------------------------------------------------------------------------
+# the acceptance e2e: SIGKILL a node mid-fit → budget absorbs → readmitted
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_crash_midfit_node_readmitted_e2e(tmp_path):
+    """ISSUE 3 acceptance: under ``photon.chaos`` a node is SIGKILLed
+    (``os._exit``) mid-fit in round 1. The round must complete within the
+    failure budget, the multiprocess supervisor respawns the node, the
+    server re-broadcasts and readmits it, and subsequent rounds aggregate
+    full capacity from it again."""
+    from photon_tpu.checkpoint import FileStore
+    from photon_tpu.federation import MultiprocessDriver, ParamTransport, ServerApp
+
+    cfg = make_cfg(
+        tmp_path, n_rounds=3, n_total_clients=2, n_clients_per_round=2,
+        local_steps=1, accept_failures_cnt=1,
+    )
+    cfg.photon.comm_stack.shm = False
+    cfg.photon.comm_stack.objstore = True  # cross-process bulk plane
+    cfg.photon.chaos.enabled = True
+    cfg.photon.chaos.crash_phase = "mid-fit"
+    cfg.photon.chaos.crash_round = 1
+    cfg.photon.chaos.crash_node_id = "node0"
+    cfg.photon.chaos.crash_marker = str(tmp_path / "crash_marker")
+    cfg.validate()
+
+    driver = MultiprocessDriver(cfg, n_nodes=2, platform="cpu", n_cpu_devices=1)
+    store = FileStore(cfg.photon.save_path + "/store")
+    app = ServerApp(cfg, driver, ParamTransport("objstore", store=store))
+    try:
+        history = app.run()
+    finally:
+        driver.shutdown()
+
+    assert (tmp_path / "crash_marker").exists(), "the chaos crash never fired"
+    n_clients = dict(history.series("server/n_clients"))
+    # round 1 completed: the killed node's cid was retried within the budget
+    assert n_clients[1] == 2.0
+    # the node was readmitted (respawn + re-broadcast) and later rounds run
+    # at FULL capacity — a dead node may not halve the fleet forever
+    assert n_clients[2] == 2.0 and n_clients[3] == 2.0
+    assert history.cumulative("server/nodes_readmitted") >= 1.0
+    assert history.latest("server/nodes_live") == 2.0
+    # the driver counted the respawn in its hello stats
+    assert driver.hello_stats().get("node0", {}).get("reconnects", 0) >= 1
+    # no round was recorded failed
+    assert not history.series("server/round_failed")
 
 
 @pytest.mark.slow

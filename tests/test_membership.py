@@ -1,0 +1,296 @@
+"""Elastic membership: reconnect backoff timing (injected clock/rng), the
+live → suspect → dead → readmitted state machine, ping sweeps over real
+drivers, and the per-round liveness KPIs.
+
+These are the fast tier-1 half of ISSUE 3's robustness coverage; the
+process-killing e2e lives in test_chaos.py (slow)."""
+
+import pytest
+
+from photon_tpu.federation.membership import (
+    DEAD,
+    LIVE,
+    SUSPECT,
+    LivenessTracker,
+    ReconnectPolicy,
+    hello_backoff_total,
+)
+from photon_tpu.federation.messages import Ack, FitRes, ParamPointer, Query
+from tests.test_federation import make_app, make_cfg
+
+pytestmark = pytest.mark.chaos  # rides `make chaos` (and, being fast, tier-1)
+
+
+# ---------------------------------------------------------------------------
+# ReconnectPolicy
+# ---------------------------------------------------------------------------
+
+
+class _FixedRng:
+    """rng whose .random() replays a fixed sequence (wraps around)."""
+
+    def __init__(self, vals):
+        self.vals = list(vals)
+        self.i = 0
+
+    def random(self):
+        v = self.vals[self.i % len(self.vals)]
+        self.i += 1
+        return v
+
+
+def test_backoff_exponential_and_capped():
+    p = ReconnectPolicy(base_s=0.5, max_s=8.0, jitter=0.0)
+    assert [p.delay(k) for k in range(6)] == [0.5, 1.0, 2.0, 4.0, 8.0, 8.0]
+
+
+def test_backoff_jitter_bounds_and_determinism():
+    # rng pinned to the extremes: jitter must stay within ±25%
+    lo = ReconnectPolicy(base_s=1.0, max_s=64.0, jitter=0.25, rng=_FixedRng([0.0]))
+    hi = ReconnectPolicy(base_s=1.0, max_s=64.0, jitter=0.25, rng=_FixedRng([1.0 - 1e-12]))
+    for k in range(5):
+        raw = min(64.0, 2.0**k)
+        assert lo.delay(k) == pytest.approx(raw * 0.75)
+        assert hi.delay(k) == pytest.approx(raw * 1.25, rel=1e-6)
+    # same seed sequence → same delays (the supervisor's schedule is replayable)
+    a = ReconnectPolicy(base_s=1.0, max_s=64.0, jitter=0.25, rng=_FixedRng([0.3, 0.9, 0.1]))
+    b = ReconnectPolicy(base_s=1.0, max_s=64.0, jitter=0.25, rng=_FixedRng([0.3, 0.9, 0.1]))
+    assert [a.delay(k) for k in range(6)] == [b.delay(k) for k in range(6)]
+
+
+def test_backoff_huge_attempt_never_overflows():
+    # unlimited retries (max_attempts=0) reach arbitrarily large attempt
+    # counts: 2.0**attempt must be clamped, not raise OverflowError
+    p = ReconnectPolicy(base_s=0.5, max_s=30.0, jitter=0.0, max_attempts=0)
+    assert p.delay(5000) == 30.0
+
+
+def test_backoff_exhaustion():
+    p = ReconnectPolicy(max_attempts=3)
+    assert not p.exhausted(2)
+    assert p.exhausted(3)
+    unlimited = ReconnectPolicy(max_attempts=0)
+    assert not unlimited.exhausted(10_000)
+
+
+def test_backoff_from_config(tmp_path):
+    cfg = make_cfg(tmp_path)
+    cfg.photon.membership.reconnect_backoff_base_s = 0.1
+    cfg.photon.membership.reconnect_backoff_max_s = 1.0
+    cfg.photon.membership.reconnect_backoff_jitter = 0.0
+    cfg.photon.membership.reconnect_max_attempts = 7
+    p = ReconnectPolicy.from_config(cfg.photon.membership)
+    assert (p.base_s, p.max_s, p.jitter, p.max_attempts) == (0.1, 1.0, 0.0, 7)
+
+
+def test_membership_config_validation(tmp_path):
+    cfg = make_cfg(tmp_path)
+    cfg.photon.membership.dead_after_misses = 0
+    with pytest.raises(ValueError, match="suspect_after_misses"):
+        cfg.validate()
+    cfg = make_cfg(tmp_path)
+    cfg.photon.membership.reconnect_backoff_jitter = 1.5
+    with pytest.raises(ValueError, match="jitter"):
+        cfg.validate()
+
+
+# ---------------------------------------------------------------------------
+# LivenessTracker state machine
+# ---------------------------------------------------------------------------
+
+
+def test_liveness_state_machine():
+    t = LivenessTracker(suspect_after_misses=1, dead_after_misses=3)
+    t.register_present(["n0"])
+    assert t.nodes["n0"].state == LIVE
+    t.observe_miss("n0")
+    assert t.nodes["n0"].state == SUSPECT
+    t.observe_miss("n0")
+    assert t.nodes["n0"].state == SUSPECT
+    t.observe_miss("n0")
+    assert t.nodes["n0"].state == DEAD
+    # a reply resets everything and counts the readmission
+    t.observe_alive("n0")
+    assert t.nodes["n0"].state == LIVE
+    assert t.nodes["n0"].misses == 0
+    assert t.readmitted_total == 1
+
+
+def test_register_present_readmits_dead_id_after_absence():
+    t = LivenessTracker(suspect_after_misses=1, dead_after_misses=2)
+    t.register_present(["n0"])
+    t.observe_miss("n0")
+    t.observe_miss("n0")
+    assert t.counts()[DEAD] == 1
+    # the id actually LEAVES the registry (TCP eviction)...
+    assert t.register_present([]) == []
+    # ...and re-registers: that's a readmission
+    assert t.register_present(["n0"]) == ["n0"]
+    assert t.counts() == {LIVE: 1, SUSPECT: 0, DEAD: 0}
+    m = t.round_metrics(hello_backoff_s=2.5)
+    assert m["server/nodes_live"] == 1.0
+    assert m["server/nodes_readmitted"] == 1.0
+    assert m["server/reconnect_backoff_s"] == 2.5
+    # per-round readmission counter resets after the snapshot
+    assert t.round_metrics()["server/nodes_readmitted"] == 0.0
+
+
+def test_wedged_but_connected_node_stays_dead():
+    """A node whose socket stays open but never answers pings must go dead
+    and STAY dead — continued registry presence is not a reappearance, and
+    the readmission KPI must not oscillate."""
+    t = LivenessTracker(suspect_after_misses=1, dead_after_misses=2,
+                        ping_timeout_s=0.05)
+    d = _ScriptedDriver({"n0": "silent"})
+    t.sweep(d)
+    t.sweep(d)
+    assert t.nodes["n0"].state == DEAD
+    for _ in range(3):  # rounds keep registering + sweeping: no flapping
+        assert t.register_present(d.node_ids()) == []
+        assert t.sweep(d) == []
+        assert t.nodes["n0"].state == DEAD
+    assert t.readmitted_total == 0
+    # it finally answers a ping: THAT readmits
+    d.behaviors["n0"] = "ok"
+    assert t.sweep(d) == ["n0"]
+    assert t.nodes["n0"].state == LIVE and t.readmitted_total == 1
+
+
+def test_note_readmitted_always_counts():
+    # the window sees deaths (EOF dead-letters) before the sweep moves
+    # states, so readmission must count even from LIVE
+    t = LivenessTracker()
+    t.register_present(["n0"])
+    t.note_readmitted("n0")
+    assert t.readmitted_total == 1
+
+
+class _ScriptedDriver:
+    """Driver double: scripted per-node ping behavior, no sockets."""
+
+    def __init__(self, behaviors):
+        self.behaviors = dict(behaviors)  # nid -> "ok" | "dead" | "silent"
+        self._mid = iter(range(10_000))
+        self._replies = []
+
+    def node_ids(self):
+        return sorted(self.behaviors)
+
+    def send(self, nid, msg):
+        mid = next(self._mid)
+        b = self.behaviors[nid]
+        if b == "ok":
+            self._replies.append((nid, mid, Ack(ok=True, node_id=nid)))
+        elif b == "dead":
+            self._replies.append((nid, mid, Ack(ok=False, detail="node died", node_id=nid)))
+        # "silent": no reply ever
+        return mid
+
+    def recv_any(self, timeout=None):
+        if not self._replies:
+            raise TimeoutError("nothing")
+        return self._replies.pop(0)
+
+
+def test_sweep_transitions_and_stale_drain():
+    clock = [0.0]
+    t = LivenessTracker(suspect_after_misses=1, dead_after_misses=2,
+                        ping_timeout_s=10.0, clock=lambda: clock[0])
+    d = _ScriptedDriver({"n0": "ok", "n1": "silent", "n2": "dead"})
+    t.sweep(d)
+    assert t.nodes["n0"].state == LIVE
+    assert t.nodes["n1"].state == SUSPECT
+    assert t.nodes["n2"].state == SUSPECT
+    t.sweep(d)
+    assert t.nodes["n1"].state == DEAD
+    assert t.nodes["n2"].state == DEAD
+    # n1 comes back: the answered ping readmits it (its id never left the
+    # registry, so presence alone could not)
+    d.behaviors["n1"] = "ok"
+    readmitted = t.sweep(d)
+    assert "n1" in readmitted
+    assert t.nodes["n1"].state == LIVE
+    # a node known to the tracker but GONE from the registry misses too
+    del d.behaviors["n2"]
+    t.sweep(d)
+    assert t.nodes["n2"].state == DEAD
+
+
+def test_sweep_hands_stale_replies_to_callback():
+    class _StaleDriver(_ScriptedDriver):
+        def __init__(self):
+            super().__init__({"n0": "ok"})
+            # a late FitRes from a previous round sits in the queue with a
+            # mid the sweep never issued
+            ptr = ParamPointer("inline", "", '{"names": [], "shapes": [], "dtypes": []}', inline=[])
+            self._replies.append(("n0", 99_999, FitRes(1, 0, ptr)))
+
+    freed = []
+    t = LivenessTracker()
+    t.sweep(_StaleDriver(), on_stale=freed.append)
+    assert len(freed) == 1 and isinstance(freed[0], FitRes)
+    assert t.nodes["n0"].state == LIVE
+
+
+def test_hello_backoff_total():
+    assert hello_backoff_total(None) == 0.0
+    assert hello_backoff_total({}) == 0.0
+    assert hello_backoff_total(
+        {"n0": {"reconnects": 2, "backoff_s": 1.5}, "n1": {"backoff_s": 0.5}}
+    ) == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# ServerApp integration (in-process driver)
+# ---------------------------------------------------------------------------
+
+
+def test_round_loop_records_liveness_kpis(tmp_path):
+    cfg = make_cfg(tmp_path, n_rounds=2)
+    app = make_app(cfg, tmp_path)
+    history = app.run()
+    app.driver.shutdown()
+    for key in ("server/nodes_live", "server/nodes_suspect", "server/nodes_dead",
+                "server/nodes_readmitted", "server/reconnect_backoff_s"):
+        assert len(history.series(key)) == 2, key
+    assert history.latest("server/nodes_live") == 2.0
+    assert history.latest("server/nodes_dead") == 0.0
+    assert history.latest("server/nodes_readmitted") == 0.0
+
+
+def test_broadcast_frees_stale_late_replies(tmp_path):
+    """A late FitRes draining during the NEXT round's broadcast (possible
+    whenever the ping sweep is off) must free its transport segment, not
+    silently leak it."""
+    cfg = make_cfg(tmp_path, n_rounds=1)
+    cfg.photon.membership.enabled = False
+    app = make_app(cfg, tmp_path)
+    stale_ptr = ParamPointer(
+        "inline", "", '{"names": [], "shapes": [], "dtypes": []}', inline=[]
+    )
+    freed = []
+    app.transport.free = freed.append
+    app.driver._replies.insert(0, ("node0", 99_999, FitRes(1, 0, stale_ptr)))
+    app.broadcast_parameters(1)
+    assert stale_ptr in freed
+    app.driver.shutdown()
+
+
+def test_sweep_skipped_when_disabled(tmp_path):
+    cfg = make_cfg(tmp_path, n_rounds=1)
+    cfg.photon.membership.enabled = False
+    app = make_app(cfg, tmp_path)
+    pings = []
+    orig_send = app.driver.send
+
+    def send(nid, msg):
+        if isinstance(msg, Query) and msg.action == "ping":
+            pings.append(nid)
+        return orig_send(nid, msg)
+
+    app.driver.send = send
+    history = app.run()
+    app.driver.shutdown()
+    assert not pings
+    # KPIs still recorded (register_present keeps the registry view fresh)
+    assert history.latest("server/nodes_live") == 2.0

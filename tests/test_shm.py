@@ -20,7 +20,7 @@ from photon_tpu.shm import (
     write_params,
     write_scalar,
 )
-from photon_tpu.shm.plane import cleanup_stale
+from photon_tpu.shm.plane import cleanup_stale, sweep_stale_tmp
 
 
 @pytest.fixture
@@ -113,6 +113,50 @@ def test_cleanup_stale():
     from photon_tpu.shm.plane import _path
 
     assert not _path(n).exists()
+
+
+@pytest.mark.chaos
+def test_sweep_stale_tmp_reaps_dead_writers_only():
+    """A node SIGKILLed mid-write leaks a pid-suffixed temp segment; the
+    transport-startup sweep reaps it iff the writer pid is dead — a live
+    writer's in-flight temp file must survive."""
+    import subprocess
+
+    from photon_tpu.shm.plane import SHM_DIR
+
+    proc = subprocess.Popen(["sleep", "0"])
+    proc.wait()  # reaped: the pid is guaranteed dead (not just a zombie)
+    tag = uuid.uuid4().hex[:8]
+    orphan = SHM_DIR / f"photon-{tag}-params.tmp-{proc.pid}"
+    own = SHM_DIR / f"photon-{tag}-own.tmp-{os.getpid()}"
+    orphan.write_bytes(b"torn")
+    own.write_bytes(b"inflight")
+    try:
+        assert sweep_stale_tmp() >= 1
+        assert not orphan.exists()
+        assert own.exists()  # our own pid is alive: left alone
+    finally:
+        orphan.unlink(missing_ok=True)
+        own.unlink(missing_ok=True)
+
+
+@pytest.mark.chaos
+def test_transport_startup_sweeps_orphans():
+    import subprocess
+
+    from photon_tpu.federation.transport import ParamTransport
+    from photon_tpu.shm.plane import SHM_DIR
+
+    proc = subprocess.Popen(["sleep", "0"])
+    proc.wait()
+    orphan = SHM_DIR / f"photon-{uuid.uuid4().hex[:8]}.tmp-{proc.pid}"
+    orphan.write_bytes(b"torn")
+    try:
+        t = ParamTransport("shm")
+        t.cleanup()
+        assert not orphan.exists()
+    finally:
+        orphan.unlink(missing_ok=True)
 
 
 def test_large_params_threaded_copy(name):

@@ -3,12 +3,13 @@ localhost sockets with node agents on threads, dead-node synthesis."""
 
 import socket
 import threading
+import time
 
 import numpy as np
 import pytest
 
 from photon_tpu.federation import NodeAgent, ParamTransport, ServerApp
-from photon_tpu.federation.messages import Query
+from photon_tpu.federation.messages import Ack, Envelope, Query
 from photon_tpu.federation.tcp import HELLO_KIND, SocketConn, TcpServerDriver
 from tests.test_federation import make_cfg
 
@@ -68,6 +69,101 @@ def test_tcp_fed_round(tmp_path):
         driver.shutdown()
     for t in threads:
         t.join(timeout=10)
+
+
+@pytest.mark.chaos
+def test_reconnect_dead_letters_inflight_promptly():
+    """A re-HELLO that replaces a stale socket must (a) drain the old
+    connection's in-flight mids as immediate dead-letter replies — not let
+    the sliding window eat a full fit_timeout_s per orphan — and (b) keep
+    the replacement registered even when the OLD socket's EOF is noticed
+    later."""
+    driver = TcpServerDriver("127.0.0.1", 0, expected_nodes=1)
+    sock1 = socket.create_connection(("127.0.0.1", driver.port))
+    conn1 = SocketConn(sock1)
+    conn1.send({"kind": HELLO_KIND, "node_id": "ghost"})
+    driver.wait_for_nodes(timeout=10)
+    mid1 = driver.send("ghost", Query("ping"))
+    mid2 = driver.send("ghost", Query("ping"))
+
+    # reconnect under the same id while both requests are in flight
+    sock2 = socket.create_connection(("127.0.0.1", driver.port))
+    conn2 = SocketConn(sock2)
+    conn2.send({"kind": HELLO_KIND, "node_id": "ghost",
+                "reconnects": 1, "backoff_s": 0.7})
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if driver.hello_stats().get("ghost", {}).get("reconnects") == 1:
+            break
+        time.sleep(0.02)
+    assert driver.hello_stats()["ghost"]["backoff_s"] == 0.7
+
+    t0 = time.monotonic()
+    replies = [driver.recv_any(timeout=5) for _ in range(2)]
+    assert time.monotonic() - t0 < 2.0, "dead letters must drain without a timeout"
+    assert {mid for _, mid, _ in replies} == {mid1, mid2}
+    for _, _, reply in replies:
+        assert not reply.ok and "node died" in reply.detail
+
+    # the replacement is still the registered node...
+    assert driver.node_ids() == ["ghost"]
+    # ...and EOF on the OLD socket must not evict it or kill new requests
+    conn1.close()
+    mid3 = driver.send("ghost", Query("ping"))
+    env = conn2.recv()
+    assert env.msg_id == mid3
+    conn2.send(Envelope(Ack(ok=True, node_id="ghost"), env.msg_id))
+    nid, mid, reply = driver.recv_any(timeout=10)
+    assert (nid, mid) == ("ghost", mid3) and reply.ok
+    assert driver.node_ids() == ["ghost"]
+    conn2.close()
+    driver.shutdown()
+
+
+@pytest.mark.chaos
+def test_run_node_supervisor_redials_with_backoff(tmp_path):
+    """Sever the node's socket server-side: the run_node supervisor must
+    back off (injected sleep records the jittered delay), redial, and
+    re-HELLO with its cumulative reconnect stats."""
+    from photon_tpu.federation.tcp import run_node
+
+    cfg = make_cfg(tmp_path, n_rounds=1, n_total_clients=1, n_clients_per_round=1)
+    cfg.photon.membership.reconnect_backoff_base_s = 0.25
+    cfg.photon.membership.reconnect_backoff_jitter = 0.25
+    driver = TcpServerDriver("127.0.0.1", 0, expected_nodes=1)
+    delays: list[float] = []
+    t = threading.Thread(
+        target=run_node,
+        args=(f"127.0.0.1:{driver.port}", "n0", cfg.to_json()),
+        kwargs={"sleep": delays.append},
+        daemon=True,
+    )
+    t.start()
+    driver.wait_for_nodes(timeout=120)  # first dial (after trainer build)
+    assert driver.hello_stats()["n0"]["reconnects"] == 0
+
+    with driver._lock:
+        stale = driver._nodes["n0"]
+    stale.close()  # simulated connection loss
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if driver.hello_stats().get("n0", {}).get("reconnects") == 1:
+            break
+        time.sleep(0.05)
+    stats = driver.hello_stats()["n0"]
+    assert stats["reconnects"] == 1
+    # exactly one backoff was taken, within the jitter envelope of base·2^0
+    assert len(delays) == 1
+    assert 0.25 * 0.75 <= delays[0] <= 0.25 * 1.25
+    assert stats["backoff_s"] == pytest.approx(delays[0])
+
+    # the reconnected agent still serves
+    mid = driver.send("n0", Query("ping"))
+    nid, gotmid, reply = driver.recv_any(timeout=10)
+    assert (nid, gotmid) == ("n0", mid) and reply.ok
+    driver.shutdown()
+    t.join(timeout=15)
+    assert not t.is_alive()
 
 
 def test_tcp_dead_node_synthesizes_failure():
