@@ -1,7 +1,7 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test test-all bench bench-host chaos native clean
+.PHONY: test test-all bench bench-host bench-telemetry chaos telemetry-smoke native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
@@ -21,6 +21,19 @@ bench:
 # CPU-runnable, no relay/TPU claim
 bench-host:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --host-plane
+
+# tracing-plane cost report only (tiny fed rounds, spans on vs off, plus
+# the disabled hook-site ns); CPU-runnable, no relay/TPU claim
+bench-telemetry:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --telemetry-overhead
+
+# telemetry smoke (ISSUE 4): the whole tracing/event/registry suite — the
+# fast half (in-process 1-round run → merged Perfetto trace parses with
+# server+client spans, KPI registry) also rides tier-1; the slow half adds
+# the REAL multiprocess + TCP trace-propagation e2es
+telemetry-smoke:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_telemetry.py -q -m "slow or not slow"
 
 # the chaos-marked fault-injection + elasticity suite (incl. the slow
 # SIGKILL/rejoin e2es): deterministic — every test pins

@@ -19,6 +19,8 @@ import random
 import zlib
 from typing import Callable
 
+from photon_tpu import telemetry
+
 # resolved lazily to avoid a config<->chaos import cycle: config/schema.py
 # validates ChaosConfig fields, chaos only reads them
 
@@ -71,23 +73,32 @@ class FaultInjector:
             "store_slow": 0, "store_partial": 0, "store_bitflip": 0, "crash": 0,
         }
 
+    def _fired(self, kind: str, **attrs) -> None:
+        """Count a fired fault + structured telemetry event with trace
+        correlation (``chaos/{kind}`` in the JSONL event log, so a dropped
+        frame or slow write is attributable to the exact round/fit span it
+        hit). The emit is a None check when telemetry is off — the chaos
+        plane must not tax itself."""
+        self.counts[kind] += 1
+        telemetry.emit_event(f"chaos/{kind}", scope=self.scope, **attrs)
+
     # -- TCP control plane ----------------------------------------------
     def tcp_plan(self) -> TcpFaultPlan:
         c = self.cfg
         plan = TcpFaultPlan()
         if c.tcp_drop_p and self.rng.random() < c.tcp_drop_p:
             plan.drop = True
-            self.counts["tcp_drop"] += 1
+            self._fired("tcp_drop")
             return plan  # a dropped frame can't also be delayed/duplicated
         if c.tcp_delay_p and self.rng.random() < c.tcp_delay_p:
             plan.delay_s = self.rng.uniform(0.0, c.tcp_delay_max_s)
-            self.counts["tcp_delay"] += 1
+            self._fired("tcp_delay", delay_s=plan.delay_s)
         if c.tcp_duplicate_p and self.rng.random() < c.tcp_duplicate_p:
             plan.duplicate = True
-            self.counts["tcp_duplicate"] += 1
+            self._fired("tcp_duplicate")
         if c.tcp_corrupt_p and self.rng.random() < c.tcp_corrupt_p:
             plan.corrupt = True
-            self.counts["tcp_corrupt"] += 1
+            self._fired("tcp_corrupt")
         return plan
 
     def corrupt_bytes(self, data: bytes) -> bytes:
@@ -105,13 +116,13 @@ class FaultInjector:
         plan = StoreFaultPlan()
         if c.store_slow_p and self.rng.random() < c.store_slow_p:
             plan.delay_s = self.rng.uniform(0.0, c.store_slow_max_s)
-            self.counts["store_slow"] += 1
+            self._fired("store_slow", delay_s=plan.delay_s)
         if c.store_partial_p and self.rng.random() < c.store_partial_p:
             plan.partial = True
-            self.counts["store_partial"] += 1
+            self._fired("store_partial")
         elif c.store_bitflip_p and self.rng.random() < c.store_bitflip_p:
             plan.bitflip = True
-            self.counts["store_bitflip"] += 1
+            self._fired("store_bitflip")
         return plan
 
     # -- node crash ------------------------------------------------------
@@ -134,7 +145,11 @@ class FaultInjector:
             except OSError:
                 return  # unreachable marker path: fail open (no crash)
             os.close(fd)
-        self.counts["crash"] += 1
+        # _fired BEFORE the kill: with a test-injected crash_fn the event is
+        # observable; with the real os._exit a buffered node-side event is
+        # lost with the process — exactly what SIGKILL semantics promise
+        self._fired("crash", phase=phase, server_round=server_round,
+                    node_id=node_id)
         self.crash_fn(137)
 
 

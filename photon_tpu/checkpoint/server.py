@@ -25,6 +25,7 @@ from typing import Any
 
 import numpy as np
 
+from photon_tpu import telemetry
 from photon_tpu.checkpoint.serialization import (
     arrays_to_npz,
     bytes_to_state,
@@ -33,6 +34,7 @@ from photon_tpu.checkpoint.serialization import (
 )
 from photon_tpu.checkpoint.store import ObjectStore
 from photon_tpu.codec import ParamsMetadata
+from photon_tpu.utils.profiling import CKPT_ASYNC_WRITE_S
 
 PARAMS_FILE = "current_server_parameters.npz"
 STATE_FILE = "state.bin"
@@ -111,15 +113,21 @@ class ServerCheckpointManager:
         params = list(parameters)
         state = {k: list(v) for k, v in (strategy_state or {}).items()}
         server = dict(server_state or {})
+        # the writer thread has no span context of its own: capture the
+        # enqueuing round's context NOW so the background write renders as a
+        # child of the round that requested it (telemetry plane)
+        trace_ctx = telemetry.current_context()
         t_enqueue = time.monotonic()
 
         def _write() -> None:
             t0 = time.monotonic()
             try:
-                self.save_round(server_round, metadata, params, state, server)
-                if cleanup_keep is not None:
-                    keep, keys = cleanup_keep
-                    self.cleanup(keep, keys)
+                with telemetry.span(CKPT_ASYNC_WRITE_S, parent=trace_ctx,
+                                    round=server_round):
+                    self.save_round(server_round, metadata, params, state, server)
+                    if cleanup_keep is not None:
+                        keep, keys = cleanup_keep
+                        self.cleanup(keep, keys)
             except BaseException as e:  # noqa: BLE001 — re-raised at the barrier
                 self._pending_error = e
             finally:

@@ -275,6 +275,26 @@ class ChaosConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Distributed tracing + structured telemetry plane (``photon_tpu/telemetry``).
+
+    OFF by default; disabled cost is a single ``None`` check per hook site
+    (the same discipline as ``photon.chaos``). Enabled, the server merges
+    its own round-phase spans with client spans shipped back on
+    ``FitRes``/``EvaluateRes`` into one Perfetto/Chrome-trace JSON under
+    ``dir``, writes a structured JSONL event log (membership transitions,
+    chaos injections, reconnects, corrupt frames) alongside it, and — with
+    ``prom_port`` set — serves the latest-round History KPIs at
+    ``http://127.0.0.1:{prom_port}/metrics`` in Prometheus text format.
+    """
+
+    enabled: bool = False
+    dir: str = ""  # "" → {photon.save_path}/telemetry
+    prom_port: int = 0  # 0 = no /metrics endpoint
+    max_buffered_spans: int = 4096  # per-process cap; overflow drops oldest
+
+
+@dataclass
 class MembershipConfig:
     """Elastic node membership (``federation/membership.py``).
 
@@ -365,6 +385,7 @@ class PhotonConfig:
     compression: CompressionConfig = field(default_factory=CompressionConfig)
     membership: MembershipConfig = field(default_factory=MembershipConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     save_path: str = "/tmp/photon_tpu"
 
 
@@ -552,6 +573,17 @@ class Config:
             )
         if mem.reconnect_max_attempts < 0:
             raise ValueError("membership.reconnect_max_attempts must be >= 0 (0 = unlimited)")
+        tel = self.photon.telemetry
+        if not 0 <= tel.prom_port <= 65535:
+            raise ValueError(
+                f"telemetry.prom_port must be in [0, 65535] (0 = off), got "
+                f"{tel.prom_port}"
+            )
+        if tel.max_buffered_spans < 1:
+            raise ValueError(
+                f"telemetry.max_buffered_spans must be >= 1, got "
+                f"{tel.max_buffered_spans}"
+            )
         from photon_tpu.chaos.injector import validate_chaos_config
 
         validate_chaos_config(self.photon.chaos)

@@ -33,6 +33,7 @@ def _stable_seed(*parts) -> int:
     process, which would desync spawned node agents)."""
     return zlib.crc32("/".join(str(p) for p in parts).encode()) & 0x7FFFFFFF
 
+from photon_tpu import telemetry
 from photon_tpu.checkpoint.client import ClientCheckpointManager
 from photon_tpu.codec import ParamsMetadata
 from photon_tpu.config.schema import Config
@@ -41,6 +42,18 @@ from photon_tpu.federation.configs import EvaluateRoundConfig, FitRoundConfig
 from photon_tpu.federation.messages import ClientState, EvaluateIns, EvaluateRes, FitIns, FitRes
 from photon_tpu.federation.transport import ParamTransport
 from photon_tpu.train.trainer import Trainer
+from photon_tpu.utils.profiling import (
+    CLIENT_ENCODE_SPAN,
+    CLIENT_EVALUATE_SPAN,
+    CLIENT_FIT_INIT_TIME,
+    CLIENT_FIT_SPAN,
+    CLIENT_PACKAGE_SPAN,
+    CLIENT_PARAM_NORM,
+    CLIENT_PSEUDO_GRAD_NORM,
+    CLIENT_RESOLVE_PARAMS_SPAN,
+    CLIENT_SKIPPED_ROUND,
+    CLIENT_TRAIN_SPAN,
+)
 
 
 def _l2(arrays: list[np.ndarray]) -> float:
@@ -142,6 +155,15 @@ class ClientRuntime:
 
     # -- fit -------------------------------------------------------------
     def fit(self, ins: FitIns, cid: int) -> FitRes:
+        # umbrella span (client/fit — NOT the client/fit_time KPI name,
+        # which is the train loop alone): covers init, resolve, train,
+        # encode, package, and the failure path, so an errored fit shows
+        # its true cost on the timeline.
+        with telemetry.span(CLIENT_FIT_SPAN, round=ins.server_round, cid=cid,
+                            node=self.node_id):
+            return self._fit_guarded(ins, cid)
+
+    def _fit_guarded(self, ins: FitIns, cid: int) -> FitRes:
         t_start = time.monotonic()
         try:
             return self._fit_inner(ins, cid, t_start)
@@ -174,11 +196,12 @@ class ClientRuntime:
             return self._package_result(
                 ins, cid, state_in, pm, pa,
                 n_samples=ins.local_steps * cfg.train.global_batch_size,
-                metrics={"client/skipped_round": 1.0},
+                metrics={CLIENT_SKIPPED_ROUND: 1.0},
                 t_start=t_start,
             )
 
-        meta, arrays = self._resolve_params(ins.params)
+        with telemetry.span(CLIENT_RESOLVE_PARAMS_SPAN, cid=cid):
+            meta, arrays = self._resolve_params(ins.params)
 
         # momenta piggybacking: [params|m1|m2] payloads (reference
         # ``manipulate_pre_training_ndarrays``, ``clients/utils.py:405-511``)
@@ -243,16 +266,18 @@ class ClientRuntime:
         from photon_tpu.chaos import crash_point
 
         crash_point("mid-fit", ins.server_round, self.node_id)
-        fit_metrics = self.trainer.fit(
-            loader, ins.local_steps, log_every=cfg.train.log_interval
-        )
+        with telemetry.span(CLIENT_TRAIN_SPAN, cid=cid,
+                            local_steps=ins.local_steps):
+            fit_metrics = self.trainer.fit(
+                loader, ins.local_steps, log_every=cfg.train.log_interval
+            )
         # reference KPI decomposition (``llm_client_functions.py:161-209``):
         # init = everything before the train loop (knob validation, param
         # resolution, momenta split, personalization, loader build/fast-
         # forward); fit_time = the loop. Trainer.fit itself reports
         # client/fit_set_parameters_time as the device hand-off alone —
         # the runtime must not widen that definition (round-4 review).
-        fit_metrics["client/fit_init_time"] = t_fit0 - t_start
+        fit_metrics[CLIENT_FIT_INIT_TIME] = t_fit0 - t_start
 
         out_meta, out_arrays = self.trainer.get_parameters()
         n_samples = ins.local_steps * cfg.train.global_batch_size
@@ -260,8 +285,8 @@ class ClientRuntime:
         # pseudo-gradient telemetry (reference: ``post_process_client_result``
         # L2 norms, ``clients/utils.py:599-619``)
         delta = [o - i for o, i in zip(out_arrays, initial)]
-        fit_metrics["client/pseudo_grad_norm"] = _l2(delta)
-        fit_metrics["client/param_norm"] = _l2(out_arrays)
+        fit_metrics[CLIENT_PSEUDO_GRAD_NORM] = _l2(delta)
+        fit_metrics[CLIENT_PARAM_NORM] = _l2(out_arrays)
 
         if knobs.personalize_patterns:
             self._personal[cid] = [a.copy() for a in out_arrays]
@@ -293,21 +318,25 @@ class ClientRuntime:
     ) -> FitRes:
         wall = time.monotonic() - t_start
         # uplink payloads go through the wire codec when one is configured
-        # (delta against this round's broadcast, EF residuals keyed by cid)
-        ptr = self.transport.put(
-            f"fit-r{ins.server_round}-c{cid}-{self.node_id}", meta, arrays,
-            compress=True, key=cid,
-        )
-        new_state = ClientState(
-            cid=cid,
-            steps_cumulative=state_in.steps_cumulative + ins.local_steps,
-            samples_cumulative=state_in.samples_cumulative + n_samples,
-            last_round=ins.server_round,
-            wall_time_s=state_in.wall_time_s + wall,
-        )
-        metrics = dict(metrics)
-        metrics["node_training_time_s"] = wall
-        self._history(cid).record(ins.server_round, metrics)
+        # (delta against this round's broadcast, EF residuals keyed by cid);
+        # the encode span covers codec + plane write — the upload leg of the
+        # client timeline
+        with telemetry.span(CLIENT_ENCODE_SPAN, cid=cid):
+            ptr = self.transport.put(
+                f"fit-r{ins.server_round}-c{cid}-{self.node_id}", meta, arrays,
+                compress=True, key=cid,
+            )
+        with telemetry.span(CLIENT_PACKAGE_SPAN, cid=cid):
+            new_state = ClientState(
+                cid=cid,
+                steps_cumulative=state_in.steps_cumulative + ins.local_steps,
+                samples_cumulative=state_in.samples_cumulative + n_samples,
+                last_round=ins.server_round,
+                wall_time_s=state_in.wall_time_s + wall,
+            )
+            metrics = dict(metrics)
+            metrics["node_training_time_s"] = wall
+            self._history(cid).record(ins.server_round, metrics)
         return FitRes(
             server_round=ins.server_round,
             cid=cid,
@@ -319,6 +348,11 @@ class ClientRuntime:
 
     # -- eval ------------------------------------------------------------
     def evaluate(self, ins: EvaluateIns, cid: int) -> EvaluateRes:
+        with telemetry.span(CLIENT_EVALUATE_SPAN, round=ins.server_round,
+                            cid=cid, node=self.node_id):
+            return self._evaluate_inner(ins, cid)
+
+    def _evaluate_inner(self, ins: EvaluateIns, cid: int) -> EvaluateRes:
         try:
             # validate knobs BEFORE the expensive compute (matches the fit
             # path's fail-fast at the top of _fit_inner)

@@ -43,6 +43,14 @@ from photon_tpu.codec import params_to_ndarrays
 from photon_tpu.config.schema import Config
 from photon_tpu.federation.client_runtime import ClientRuntime
 from photon_tpu.federation.messages import FitIns
+from photon_tpu.utils.profiling import (
+    COLLECTIVE_AGG_TIME,
+    EVAL_LOSS,
+    EVAL_SAMPLES,
+    FIT_ROUND_TIME,
+    ROUND_TIME,
+    STEPS_CUMULATIVE,
+)
 from photon_tpu.federation.transport import ParamTransport
 from photon_tpu.metrics.history import History
 from photon_tpu.parallel.collective_agg import (
@@ -241,11 +249,11 @@ class CollectiveFedRunner:
         metrics = self.strategy.apply_average(
             server_round, avg, n_total, cfg.fl.n_total_clients
         )
-        metrics["server/collective_agg_time"] = time.monotonic() - t_agg
-        metrics["server/fit_round_time"] = time.monotonic() - t_fit
+        metrics[COLLECTIVE_AGG_TIME] = time.monotonic() - t_agg
+        metrics[FIT_ROUND_TIME] = time.monotonic() - t_fit
         self.server_steps_cumulative += cfg.fl.local_steps
-        metrics["server/steps_cumulative"] = float(self.server_steps_cumulative)
-        metrics["server/round_time"] = time.monotonic() - t_round
+        metrics[STEPS_CUMULATIVE] = float(self.server_steps_cumulative)
+        metrics[ROUND_TIME] = time.monotonic() - t_round
         self.history.record(server_round, metrics)
         return metrics
 
@@ -286,8 +294,8 @@ class CollectiveFedRunner:
             [loss_global], ns_global, self.mesh, return_total=True
         )
         metrics = {
-            "server/eval_loss": float(np.asarray(avg[0])[0]),
-            "server/eval_samples": float(np.asarray(total)),
+            EVAL_LOSS: float(np.asarray(avg[0])[0]),
+            EVAL_SAMPLES: float(np.asarray(total)),
         }
         self.history.record(server_round, metrics)
         return metrics

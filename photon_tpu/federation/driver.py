@@ -24,6 +24,7 @@ import time
 from multiprocessing.connection import wait as mp_wait
 from typing import Any, Callable
 
+from photon_tpu import telemetry
 from photon_tpu.config.schema import Config
 from photon_tpu.federation.messages import Ack, Envelope, Query
 from photon_tpu.federation.node import NodeAgent, node_process_main
@@ -152,7 +153,9 @@ class MultiprocessDriver(Driver):
             return mid
         proc, conn = entry
         try:
-            conn.send(Envelope(msg, mid))
+            # trace context rides the envelope so node-side spans parent to
+            # the server span that sent the work (None when telemetry off)
+            conn.send(Envelope(msg, mid, trace=telemetry.current_context()))
         except (OSError, ValueError):
             # broken pipe with no reader: the node died while IDLE (nothing
             # in flight, so recv_any never polled its pipe to hit the

@@ -28,11 +28,22 @@ import dataclasses
 import time
 from typing import Callable, Iterable
 
+from photon_tpu import telemetry
 from photon_tpu.federation.messages import Ack, Query
 
 LIVE = "live"
 SUSPECT = "suspect"
 DEAD = "dead"
+
+
+def _transition_event(nid: str, old: str, new: str, **attrs) -> None:
+    """Structured membership event (telemetry plane): every state-machine
+    edge — including first registration (``new → live``) — lands in the
+    JSONL event log with trace correlation to the round span that observed
+    it. A None check when telemetry is off."""
+    telemetry.emit_event(
+        "membership/transition", node=nid, **{"from": old, "to": new}, **attrs
+    )
 
 
 @dataclasses.dataclass
@@ -114,27 +125,46 @@ class LivenessTracker:
         self._readmitted_round = 0
 
     # -- state transitions ----------------------------------------------
+    def _track(self, nid: str, announce: bool = True) -> NodeHealth:
+        """Get-or-create a node record; a brand-new id emits the
+        ``new → live`` registration event (guarantees at least one
+        membership event per traced run). ``announce=False`` for sites
+        whose first observation is a MISS — a never-seen node that failed
+        its first ping must not log a phantom liveness edge."""
+        h = self.nodes.get(nid)
+        if h is None:
+            h = self.nodes[nid] = NodeHealth()
+            if announce:
+                _transition_event(nid, "new", LIVE)
+        return h
+
     def observe_alive(self, nid: str) -> None:
-        h = self.nodes.setdefault(nid, NodeHealth())
+        h = self._track(nid)
+        old = h.state
         if h.state == DEAD:
             self._readmit(h)
         h.state = LIVE
         h.misses = 0
+        if old != LIVE:
+            _transition_event(nid, old, LIVE, readmitted=old == DEAD)
 
     def observe_miss(self, nid: str) -> None:
-        h = self.nodes.setdefault(nid, NodeHealth())
+        h = self._track(nid, announce=False)
+        old = h.state
         h.misses += 1
         if h.misses >= self.dead_after:
             h.state = DEAD
         elif h.misses >= self.suspect_after:
             h.state = SUSPECT
+        if h.state != old:
+            _transition_event(nid, old, h.state, misses=h.misses)
 
     def touch(self, nid: str) -> None:
         """Start tracking an id (mid-round new join) WITHOUT the absence
         bookkeeping of :meth:`register_present` — passing a single id there
         would flag every other tracked node absent and arm the false
         readmission the ``absent`` invariant exists to prevent."""
-        self.nodes.setdefault(nid, NodeHealth())
+        self._track(nid)
 
     def note_readmitted(self, nid: str) -> None:
         """Rejoin observed by the scheduler (sliding window): a node died
@@ -142,10 +172,14 @@ class LivenessTracker:
         re-sent, and is back in rotation. Always counts — the scheduler sees
         deaths (EOF dead-letters) faster than the ping sweep moves states,
         so the tracker may still say LIVE."""
-        h = self.nodes.setdefault(nid, NodeHealth())
+        h = self._track(nid)
+        old = h.state
         self._readmit(h)
         h.state = LIVE
         h.misses = 0
+        # one vocabulary for every readmission path: the node's state after
+        # a readmission IS live; `readmitted` marks the edge kind
+        _transition_event(nid, old, LIVE, readmitted=True)
 
     def _readmit(self, h: NodeHealth) -> None:
         h.readmissions += 1
@@ -168,12 +202,14 @@ class LivenessTracker:
             self.nodes[nid].absent = True
         readmitted: list[str] = []
         for nid in id_set:
-            h = self.nodes.setdefault(nid, NodeHealth())
+            h = self._track(nid)
             if h.state == DEAD and h.absent:
                 self._readmit(h)
                 h.state = LIVE
                 h.misses = 0
                 readmitted.append(nid)
+                _transition_event(nid, DEAD, LIVE, readmitted=True,
+                                  reappeared=True)
             h.absent = False
         return readmitted
 
@@ -210,10 +246,14 @@ class LivenessTracker:
                     on_stale(reply)
                 continue
             pnid = pending.pop(mid)
+            # ping acks are the flush channel for nodes that never get
+            # sampled: their buffered spans/events ride back here
+            telemetry.ingest(getattr(reply, "spans", None),
+                             getattr(reply, "events", None))
             if isinstance(reply, Ack) and reply.ok:
                 # an answered ping readmits a dead node even if its id never
                 # left the registry (multiprocess respawns keep the id)
-                if self.nodes.setdefault(pnid, NodeHealth()).state == DEAD:
+                if self._track(pnid).state == DEAD:
                     readmitted.append(pnid)
                 self.observe_alive(pnid)
             else:

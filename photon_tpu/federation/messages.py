@@ -86,6 +86,12 @@ class FitRes:
     metrics: dict[str, float] = field(default_factory=dict)
     client_state: dict | None = None
     error: str | None = None  # non-None = failure (reference WorkerResultMessage(-1))
+    # telemetry piggyback (photon_tpu/telemetry): completed client spans +
+    # buffered events drained by the node agent and shipped back with the
+    # result, so the SERVER holds the merged per-run timeline. None when
+    # telemetry is off — zero wire cost on the disabled path.
+    spans: list | None = None
+    events: list | None = None
 
 
 @dataclass
@@ -105,6 +111,9 @@ class EvaluateRes:
     n_samples: int = 0
     metrics: dict[str, float] = field(default_factory=dict)
     error: str | None = None
+    # telemetry piggyback — see FitRes.spans/events
+    spans: list | None = None
+    events: list | None = None
 
 
 @dataclass
@@ -121,6 +130,12 @@ class Ack:
     ok: bool = True
     detail: str = ""
     node_id: str = ""
+    # telemetry piggyback (see FitRes.spans/events): acks are the flush
+    # channel for nodes that handle broadcasts/pings but are never sampled
+    # for a fit — without it their reconnect events and transport-leg spans
+    # would sit in the node buffer forever
+    spans: list | None = None
+    events: list | None = None
 
 
 @dataclass
@@ -134,8 +149,16 @@ class Query:
 
 @dataclass
 class Envelope:
-    """Transport wrapper with correlation id + timing (the Message analog)."""
+    """Transport wrapper with correlation id + timing (the Message analog).
+
+    ``trace`` is the sender's current span context ``(trace_id, span_id)``
+    when telemetry is on (``photon_tpu/telemetry``): the receiving node
+    attaches it as the remote parent, so client-side fit/eval spans nest
+    under the server's round span across the process boundary. ``None``
+    (telemetry off) costs nothing on the wire beyond the field tag.
+    """
 
     msg: Any
     msg_id: int
     sent_at: float = field(default_factory=time.time)
+    trace: tuple | None = None

@@ -16,7 +16,7 @@ from __future__ import annotations
 import traceback
 from typing import Any, Callable
 
-from photon_tpu import chaos
+from photon_tpu import chaos, telemetry
 from photon_tpu.config.schema import Config
 from photon_tpu.federation.client_runtime import ClientRuntime
 from photon_tpu.federation.messages import (
@@ -24,6 +24,7 @@ from photon_tpu.federation.messages import (
     Broadcast,
     Envelope,
     EvaluateIns,
+    EvaluateRes,
     FitIns,
     FitRes,
     Query,
@@ -88,6 +89,31 @@ class NodeAgent:
             return Ack(ok=True, detail="bye", node_id=self.node_id)
         return Ack(ok=False, detail=f"unknown query {q.action!r}", node_id=self.node_id)
 
+    def _piggyback_telemetry(self, reply: Any) -> None:
+        """Drain this process's completed spans + buffered events onto the
+        outgoing reply (the server ingests them into the merged timeline).
+        Fit/eval results are the main channel; single Acks (broadcast,
+        ping, shutdown) carry the buffers too, so a node that is never
+        sampled for a fit still flushes its reconnect events and
+        transport-leg spans on every ping sweep. Only for piggyback-mode
+        tracers — an in-process node shares the SERVER's tracer, where
+        draining would momentarily pull server spans out of the export
+        buffer."""
+        tr = telemetry.active()
+        if tr is None or not tr.piggyback:
+            return
+        if isinstance(reply, list):
+            carriers = [r for r in reply if isinstance(r, (FitRes, EvaluateRes))]
+            carrier = carriers[-1] if carriers else None
+        elif isinstance(reply, Ack):
+            carrier = reply
+        else:
+            carrier = None
+        if carrier is None:
+            return
+        carrier.spans = tr.drain()
+        carrier.events = telemetry.drain_events()
+
     # -- serving loop (child process entry) ------------------------------
     def serve(self, conn) -> bool:
         """Blocking loop over a Connection-like object with send/recv.
@@ -121,13 +147,18 @@ class NodeAgent:
             recent.append(env.msg_id)
             recent_set.add(env.msg_id)
             try:
-                reply = self.handle(env.msg)
+                # envelope trace context = the sending server span: spans
+                # opened while handling parent to it across the process
+                # boundary (a no-op context when telemetry is off)
+                with telemetry.attach(env.trace):
+                    reply = self.handle(env.msg)
             except Exception as e:  # noqa: BLE001 — never kill the loop silently
                 reply = Ack(
                     ok=False,
                     detail=f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
                     node_id=self.node_id,
                 )
+            self._piggyback_telemetry(reply)
             if isinstance(reply, list) and any(isinstance(r, FitRes) for r in reply):
                 # work done, result not yet on the wire — the nastiest crash
                 # window (the server must charge the cid to its budget AND
@@ -155,6 +186,9 @@ def node_process_main(cfg_json: str, node_id: str, conn, platform: str | None, n
 
     cfg = Config.from_json(cfg_json)
     chaos.install(cfg.photon.chaos, scope=node_id)
+    # spawned node: buffer spans/events locally, ship them back piggybacked
+    # on fit/eval results (the server holds the merged timeline)
+    telemetry.install(cfg.photon.telemetry, scope=node_id, piggyback=True)
     store = None
     if cfg.photon.comm_stack.objstore or cfg.photon.checkpoint:
         from photon_tpu.checkpoint.store import FileStore

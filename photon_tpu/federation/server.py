@@ -20,6 +20,7 @@ Role parity with ``photon/server_app.py`` + ``photon/server/fit_utils.py`` /
 
 from __future__ import annotations
 
+import pathlib
 import random
 import time
 import uuid as uuid_mod
@@ -28,7 +29,7 @@ from typing import Callable, Iterator
 
 import numpy as np
 
-from photon_tpu import chaos
+from photon_tpu import chaos, telemetry
 from photon_tpu.checkpoint.server import ServerCheckpointManager
 from photon_tpu.codec import ParamsMetadata
 from photon_tpu.config.schema import Config
@@ -48,7 +49,23 @@ from photon_tpu.strategy import dispatch_strategy
 from photon_tpu.strategy.base import ClientResult
 from photon_tpu.strategy.metrics import GradientNoiseScale
 from photon_tpu.utils.hostpool import HostPool
-from photon_tpu.utils.profiling import CKPT_ASYNC_WRITE_S
+from photon_tpu.utils.profiling import (
+    BROADCAST_POST_TIME,
+    BROADCAST_PRE_TIME,
+    CHECKPOINT_TIME,
+    CKPT_ASYNC_WRITE_S,
+    CKPT_BARRIER_WAIT_S,
+    EVAL_ROUND_FAILED,
+    EVAL_ROUND_SPAN,
+    FIT_ROUND_TIME,
+    ROUND_FAILED,
+    ROUND_SPAN,
+    ROUND_TIME,
+    SAMPLE_CLIENTS_SPAN,
+    STEPS_CUMULATIVE,
+    CLIENT_PSEUDO_GRAD_NORM,
+    PSEUDO_GRAD_NORM,
+)
 
 
 class TooManyFailuresError(RuntimeError):
@@ -140,6 +157,26 @@ class ServerApp:
                 )
                 crash_fn = lambda code: None  # noqa: E731
         chaos.install(cfg.photon.chaos, scope="server", crash_fn=crash_fn)
+        # telemetry plane (ISSUE 4 tentpole): the server's tracer holds the
+        # MERGED timeline — its own round-phase spans plus client spans
+        # shipped back on fit/eval results. Events write through to JSONL
+        # immediately (they must survive a crash); the Perfetto trace is
+        # rendered at end of run. Same install discipline as chaos: a
+        # disabled config clears any tracer a previous config left behind.
+        tel = cfg.photon.telemetry
+        self.telemetry_dir = tel.dir or str(
+            pathlib.Path(cfg.photon.save_path) / "telemetry"
+        )
+        telemetry.install(
+            tel,
+            scope="server",
+            events_path=(
+                str(pathlib.Path(self.telemetry_dir) / f"events-{cfg.run_uuid}.jsonl")
+                if tel.enabled
+                else None
+            ),
+        )
+        self._prom = None
         self.server_steps_cumulative = 0
         self.client_states: dict[int, dict] = {}
         self.start_round = 1
@@ -253,6 +290,8 @@ class ServerApp:
         self.transport.set_reference(self.strategy.current_parameters)
         msg = Broadcast(server_round, ptr)
         acks = self.driver.broadcast(msg, on_stale=self._free_stale_reply)
+        for a in acks.values():
+            self._ingest_result_telemetry(a)
         # a node dying AT broadcast time is an elasticity event, not a fatal
         # error: it leaves the registry (TCP) or respawns paramless
         # (multiprocess) and the rejoin scan re-broadcasts when it returns.
@@ -283,8 +322,12 @@ class ServerApp:
     def _free_stale_reply(self, reply) -> None:
         """Free transport segments carried by a late/stale reply (a FitRes
         arriving after its cid was charged to the budget, or draining during
-        the between-rounds ping sweep) so it can't leak shm/objects."""
+        the between-rounds ping sweep) so it can't leak shm/objects. The
+        reply's piggybacked telemetry is ingested first — a quarantined
+        node's late spans are exactly the struggling-node evidence the
+        timeline exists to show."""
         for res in (reply if isinstance(reply, list) else [reply]):
+            self._ingest_result_telemetry(res)
             ptr = getattr(res, "params", None)
             if ptr is not None:
                 self.transport.free(ptr)
@@ -488,7 +531,8 @@ class ServerApp:
     # ------------------------------------------------------------------
     def fit_round(self, server_round: int) -> dict[str, float]:
         t_round = time.monotonic()
-        cids = self._sample_clients()
+        with telemetry.span(SAMPLE_CLIENTS_SPAN, round=server_round):
+            cids = self._sample_clients()
         local_steps = self.cfg.fl.local_steps
 
         def make_ins(cid_batch: list[int]) -> FitIns:
@@ -508,12 +552,15 @@ class ServerApp:
         def results() -> Iterator[ClientResult]:
             for res in self._sliding_window(server_round, cids, make_ins, timeout=self.cfg.fl.fit_timeout_s):
                 assert isinstance(res, FitRes)
+                # merge piggybacked client telemetry into the server-held
+                # timeline/event log (None fields when telemetry is off)
+                self._ingest_result_telemetry(res)
                 # decode=False: compressed payloads stay compressed until the
                 # streaming aggregation folds them in, one client at a time
                 _, arrays = self.transport.get(res.params, decode=False)
                 if res.client_state:
                     self.client_states[res.cid] = res.client_state
-                g = res.metrics.get("client/pseudo_grad_norm")
+                g = res.metrics.get(CLIENT_PSEUDO_GRAD_NORM)
                 if g is not None:
                     per_client_sq.append(float(g) ** 2)
                     per_client_n.append(res.n_samples)
@@ -521,16 +568,20 @@ class ServerApp:
                 self.transport.free(res.params)
 
         t_fit = time.monotonic()
-        new_params, metrics = self.strategy.aggregate_fit(server_round, results())
-        metrics["server/fit_round_time"] = time.monotonic() - t_fit
+        # the fit-wait span covers scheduling + client fits + streaming
+        # aggregation — the same window as the fit_round_time KPI
+        with telemetry.span(FIT_ROUND_TIME, round=server_round,
+                            n_cids=len(cids)):
+            new_params, metrics = self.strategy.aggregate_fit(server_round, results())
+        metrics[FIT_ROUND_TIME] = time.monotonic() - t_fit
         del new_params  # strategy.current_parameters already updated
 
-        agg_sq = metrics.get("server/pseudo_grad_norm", 0.0) ** 2
+        agg_sq = metrics.get(PSEUDO_GRAD_NORM, 0.0) ** 2
         metrics.update(self.gns.update(per_client_sq, per_client_n, agg_sq, sum(per_client_n)))
 
         self.server_steps_cumulative += local_steps
-        metrics["server/steps_cumulative"] = float(self.server_steps_cumulative)
-        metrics["server/round_time"] = time.monotonic() - t_round
+        metrics[STEPS_CUMULATIVE] = float(self.server_steps_cumulative)
+        metrics[ROUND_TIME] = time.monotonic() - t_round
         # bytes-on-wire: drain-since-last-fit semantics — every byte is
         # counted exactly once (a post-fit eval broadcast lands in the NEXT
         # round's numbers), so History.cumulative over the wire keys is the
@@ -554,11 +605,20 @@ class ServerApp:
             )
 
         results = []
-        for res in self._sliding_window(server_round, cids, make_ins, timeout=self.cfg.fl.eval_timeout_s):
-            assert isinstance(res, EvaluateRes)
-            results.append((res.n_samples, res.loss, res.metrics))
+        with telemetry.span(EVAL_ROUND_SPAN, round=server_round):
+            for res in self._sliding_window(server_round, cids, make_ins, timeout=self.cfg.fl.eval_timeout_s):
+                assert isinstance(res, EvaluateRes)
+                self._ingest_result_telemetry(res)
+                results.append((res.n_samples, res.loss, res.metrics))
         loss, metrics = self.strategy.aggregate_evaluate(server_round, results)
         return metrics
+
+    @staticmethod
+    def _ingest_result_telemetry(res) -> None:
+        """Fold a reply's piggybacked spans/events (FitRes, EvaluateRes, or
+        Ack) into the server-held merged timeline (a None check per reply
+        when telemetry is off)."""
+        telemetry.ingest(getattr(res, "spans", None), getattr(res, "events", None))
 
     # ------------------------------------------------------------------
     def run(self, n_rounds: int | None = None) -> History:
@@ -574,6 +634,14 @@ class ServerApp:
         if resumed is None and self.ckpt_mgr is not None and cfg.photon.checkpoint:
             self.save_checkpoint(0)  # round-0 checkpoint (reference: initialize_round)
 
+        # optional Prometheus /metrics endpoint over the live History
+        # (photon.telemetry.prom_port; stdlib HTTP, no dependency)
+        if cfg.photon.telemetry.enabled and cfg.photon.telemetry.prom_port:
+            from photon_tpu.telemetry.prom import PromServer
+
+            self._prom = PromServer(self.history, cfg.photon.telemetry.prom_port)
+            self._prom.start()
+
         if cfg.fl.eval_interval_rounds and self.start_round == 1:
             t_pre = self.broadcast_parameters(0)
             try:
@@ -581,8 +649,8 @@ class ServerApp:
             except TooManyFailuresError:
                 if not cfg.fl.ignore_failed_rounds:
                     raise
-                m = {"server/eval_round_failed": 1.0}
-            m["server/broadcast_pre_time"] = t_pre
+                m = {EVAL_ROUND_FAILED: 1.0}
+            m[BROADCAST_PRE_TIME] = t_pre
             self.history.record(0, m)
 
         try:
@@ -597,62 +665,109 @@ class ServerApp:
                     self.ckpt_mgr.wait_pending()
             finally:
                 self.free_transport()
+                try:
+                    self.export_telemetry()
+                except Exception:  # noqa: BLE001 — the trace must never take
+                    # the run down with it (nor mask the real error): a full
+                    # disk or unwritable dir costs the timeline, not History
+                    import warnings
+
+                    warnings.warn("telemetry trace export failed", stacklevel=2)
         return self.history
+
+    def export_telemetry(self) -> str | None:
+        """Render the merged Perfetto/Chrome trace (server + ingested client
+        spans, events as instant markers) and stop the /metrics endpoint.
+        Returns the trace path, or None when telemetry is off. Idempotent —
+        the round loop calls it at shutdown; tests may call it directly."""
+        if self._prom is not None:
+            self._prom.close()
+            self._prom = None
+        tr = telemetry.active()
+        if tr is None:
+            return None
+        from photon_tpu.telemetry.export import write_chrome_trace
+
+        log = telemetry.events_active()
+        path = pathlib.Path(self.telemetry_dir) / f"trace-{self.cfg.run_uuid}.json"
+        return write_chrome_trace(
+            path,
+            tr.snapshot(),
+            events=log.snapshot() if log is not None else None,
+            metadata={
+                "run_uuid": self.cfg.run_uuid,
+                "dropped_spans": tr.dropped,
+            },
+        )
 
     def _round_loop(self, cfg: Config, n_rounds: int) -> None:
         for rnd in range(self.start_round, n_rounds + 1):
-            if cfg.photon.refresh_period and rnd > 1 and (rnd - 1) % cfg.photon.refresh_period == 0:
-                from photon_tpu.federation.messages import Query
+            # one umbrella span per round (server/round — NOT the
+            # round_time KPI name, which measures a narrower window): every
+            # phase span below — and, via Envelope.trace, every client-side
+            # fit/eval span — parents under it in the merged timeline
+            with telemetry.span(ROUND_SPAN, round=rnd):
+                self._one_round(cfg, rnd)
 
-                self.driver.broadcast(Query("refresh"), on_stale=self._free_stale_reply)
-            # liveness sweep BEFORE the broadcast: readmitted nodes are back
-            # in the registry when broadcast_parameters fans out, so a
-            # crash-and-rejoin between rounds needs no special re-send
-            self._membership_round_start(rnd)
+    def _one_round(self, cfg: Config, rnd: int) -> None:
+        if cfg.photon.refresh_period and rnd > 1 and (rnd - 1) % cfg.photon.refresh_period == 0:
+            from photon_tpu.federation.messages import Query
+
+            self.driver.broadcast(Query("refresh"), on_stale=self._free_stale_reply)
+        # liveness sweep BEFORE the broadcast: readmitted nodes are back
+        # in the registry when broadcast_parameters fans out, so a
+        # crash-and-rejoin between rounds needs no special re-send
+        self._membership_round_start(rnd)
+        with telemetry.span(BROADCAST_PRE_TIME, round=rnd):
             t_pre = self.broadcast_parameters(rnd)
+        try:
+            metrics = self.fit_round(rnd)
+        except TooManyFailuresError:
+            if not cfg.fl.ignore_failed_rounds:
+                raise
+            failed = {ROUND_FAILED: 1.0}
+            failed.update(self._membership_metrics())
+            self.history.record(rnd, failed)
+            return
+        metrics[BROADCAST_PRE_TIME] = t_pre
+        metrics.update(self._membership_metrics())
+
+        if cfg.fl.eval_interval_rounds and rnd % cfg.fl.eval_interval_rounds == 0:
+            with telemetry.span(BROADCAST_POST_TIME, round=rnd):
+                t_post = self.broadcast_parameters(rnd)
             try:
-                metrics = self.fit_round(rnd)
+                metrics.update(self.evaluate_round(rnd))
             except TooManyFailuresError:
+                # one flaky client during fed eval must not kill a
+                # failure-tolerant run (reference: evaluate_round sits
+                # inside the ignore_failed_rounds wrap, ``fit_utils.py``)
                 if not cfg.fl.ignore_failed_rounds:
                     raise
-                failed = {"server/round_failed": 1.0}
-                failed.update(self._membership_metrics())
-                self.history.record(rnd, failed)
-                continue
-            metrics["server/broadcast_pre_time"] = t_pre
-            metrics.update(self._membership_metrics())
+                metrics[EVAL_ROUND_FAILED] = 1.0
+            metrics[BROADCAST_POST_TIME] = t_post
 
-            if cfg.fl.eval_interval_rounds and rnd % cfg.fl.eval_interval_rounds == 0:
-                t_post = self.broadcast_parameters(rnd)
-                try:
-                    metrics.update(self.evaluate_round(rnd))
-                except TooManyFailuresError:
-                    # one flaky client during fed eval must not kill a
-                    # failure-tolerant run (reference: evaluate_round sits
-                    # inside the ignore_failed_rounds wrap, ``fit_utils.py``)
-                    if not cfg.fl.ignore_failed_rounds:
-                        raise
-                    metrics["server/eval_round_failed"] = 1.0
-                metrics["server/broadcast_post_time"] = t_post
-
-            if (
-                self.ckpt_mgr is not None
-                and cfg.photon.checkpoint
-                and rnd % cfg.photon.checkpoint_interval == 0
-            ):
-                t_ck = time.monotonic()
+        if (
+            self.ckpt_mgr is not None
+            and cfg.photon.checkpoint
+            and rnd % cfg.photon.checkpoint_interval == 0
+        ):
+            t_ck = time.monotonic()
+            # the span covers only what the round loop BLOCKS on (snapshot +
+            # enqueue + barrier); the background write itself renders as a
+            # separate ckpt_async_write_s span overlapping the next round
+            with telemetry.span(CHECKPOINT_TIME, round=rnd):
                 self.save_checkpoint(rnd)
-                # checkpoint_time = what the round loop was BLOCKED on:
-                # snapshot + enqueue, plus — when the store is slower than a
-                # round — the barrier wait for round N-1's write, reported
-                # separately below so slow-store regimes are visible. The
-                # write itself overlaps the next round and reports as
-                # CKPT_ASYNC_WRITE_S one round later.
-                metrics["server/checkpoint_time"] = time.monotonic() - t_ck
-                metrics[CKPT_ASYNC_WRITE_S] = float(self.ckpt_mgr.last_async_write_s)
-                if self.cfg.photon.async_checkpoint:
-                    metrics["server/ckpt_barrier_wait_s"] = float(
-                        self.ckpt_mgr.last_barrier_wait_s
-                    )
+            # checkpoint_time = what the round loop was BLOCKED on:
+            # snapshot + enqueue, plus — when the store is slower than a
+            # round — the barrier wait for round N-1's write, reported
+            # separately below so slow-store regimes are visible. The
+            # write itself overlaps the next round and reports as
+            # CKPT_ASYNC_WRITE_S one round later.
+            metrics[CHECKPOINT_TIME] = time.monotonic() - t_ck
+            metrics[CKPT_ASYNC_WRITE_S] = float(self.ckpt_mgr.last_async_write_s)
+            if self.cfg.photon.async_checkpoint:
+                metrics[CKPT_BARRIER_WAIT_S] = float(
+                    self.ckpt_mgr.last_barrier_wait_s
+                )
 
-            self.history.record(rnd, metrics)
+        self.history.record(rnd, metrics)

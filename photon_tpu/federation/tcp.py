@@ -38,10 +38,11 @@ import zlib
 from collections import deque
 from typing import Any
 
-from photon_tpu import chaos
+from photon_tpu import chaos, telemetry
 from photon_tpu.federation.driver import Driver
 from photon_tpu.federation.membership import ReconnectPolicy
 from photon_tpu.federation.messages import Ack, Envelope, Query
+from photon_tpu.utils.profiling import TCP_RECV_SPAN, TCP_SEND_SPAN
 
 # frame header: payload length + CRC32 of the payload. The checksum exists
 # for the chaos corruption injector and for real bit-rot alike: a corrupt
@@ -90,9 +91,14 @@ class SocketConn:
                 data = inj.corrupt_bytes(data)
             if plan.duplicate:
                 repeat = 2
-        with self._wlock:
-            for _ in range(repeat):
-                self.sock.sendall(header + data)
+        # the send leg is a span (telemetry plane): nbytes + wall time of
+        # the syscall path, so a slow/buffer-bound control-plane write is
+        # attributable on the timeline. Measured around the lock + sendall
+        # — contention IS part of the leg the caller experiences.
+        with telemetry.timed_add(TCP_SEND_SPAN, nbytes=len(data)):
+            with self._wlock:
+                for _ in range(repeat):
+                    self.sock.sendall(header + data)
 
     def _read_exact(self, n: int) -> bytes:
         buf = bytearray()
@@ -106,8 +112,15 @@ class SocketConn:
     def recv(self) -> Any:
         with self._rlock:
             n, crc = _FRAME.unpack(self._read_exact(_FRAME.size))
-            data = self._read_exact(n)
+            # the recv leg span starts AFTER the header lands: everything
+            # before it is idle wait for the peer, which would drown the
+            # actual transport cost (payload read + unpickle) on a timeline
+            with telemetry.timed_add(TCP_RECV_SPAN, nbytes=n):
+                data = self._read_exact(n)
         if zlib.crc32(data) != crc:
+            # the teardown this forces is a structured event: correlate the
+            # connection loss with whatever round span was active
+            telemetry.emit_event("tcp/corrupt_frame", nbytes=n)
             raise CorruptFrameError(f"frame CRC mismatch ({n} bytes)")
         return pickle.loads(data)
 
@@ -220,7 +233,9 @@ class TcpServerDriver(Driver):
                 return mid
             self._inflight[node_id].append(mid)
         try:
-            conn.send(Envelope(msg, mid))
+            # trace context rides the envelope across the socket so the
+            # node's spans parent to the sending server span
+            conn.send(Envelope(msg, mid, trace=telemetry.current_context()))
         except OSError:
             pass  # surfaced as a dead-node reply in recv_any
         return mid
@@ -353,6 +368,9 @@ def run_node(
     host, _, port = server_addr.rpartition(":")
     cfg = Config.from_json(cfg_json)
     chaos.install(cfg.photon.chaos, scope=node_id)
+    # node-side telemetry buffers (no files): spans + events ship back to
+    # the server piggybacked on fit/eval results
+    telemetry.install(cfg.photon.telemetry, scope=node_id, piggyback=True)
 
     store = None
     if cfg.photon.comm_stack.objstore:
@@ -426,6 +444,12 @@ def run_node(
         reconnects += 1
         d = policy.delay(0)
         backoff_total += d
+        # buffered node-side event; rides the next fit/eval result back to
+        # the server's JSONL log
+        telemetry.emit_event(
+            "tcp/reconnect", node=node_id, reconnects=reconnects,
+            backoff_s=d, backoff_total_s=backoff_total,
+        )
         sleep(d)
 
 

@@ -19,14 +19,21 @@ class History:
         self._wandb = wandb_run
 
     def record(self, server_round: int, metrics: dict[str, float]) -> None:
+        # the wandb mirror sees exactly what the local history keeps: only
+        # the float-coercible values. Mirroring the raw dict shipped
+        # unloggable payloads (None, strings, arrays) to wandb while the
+        # local record silently dropped them — the two views of a run must
+        # not diverge (ISSUE 4 satellite).
+        coerced: dict[str, float] = {}
         for k, v in metrics.items():
             try:
                 fv = float(v)
             except (TypeError, ValueError):
                 continue
+            coerced[k] = fv
             self.rounds[k].append((server_round, fv))
         if self._wandb is not None:
-            self._wandb.log(dict(metrics), step=server_round)
+            self._wandb.log(coerced, step=server_round)
 
     def latest(self, key: str) -> float | None:
         series = self.rounds.get(key)

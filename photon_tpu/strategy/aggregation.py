@@ -31,7 +31,9 @@ from typing import Callable, Iterable, Iterator
 
 import numpy as np
 
+from photon_tpu import telemetry
 from photon_tpu.utils.hostpool import HostPool
+from photon_tpu.utils.profiling import AGG_DECODE_TIME, AGG_FOLD_TIME
 
 #: elements per fold chunk (~8 MB of fp64 transient): large enough that the
 #: ufunc dominates the Python loop, small enough that per-worker transients
@@ -105,6 +107,13 @@ def aggregate_inplace(
     t_decode = [0.0]
     t_fold = [0.0]
     it: Iterator = iter(results)
+    # per-client decode/fold windows render as spans under whatever round
+    # span is open on the CALLING thread: decode-ahead runs on a pool
+    # worker with an empty context stack, so the parent is captured here
+    # (span names = the KPI names the same seconds accumulate into)
+    tracer = telemetry.active()
+    trace_parent = telemetry.current_context() if tracer is not None else None
+    n_seen = [0]
 
     def _fetch_decode() -> tuple[list[np.ndarray], int] | None:
         """Pull + decode the next result (runs on the pool when pipelined;
@@ -117,9 +126,15 @@ def aggregate_inplace(
             item, n_cur = next(it)
         except StopIteration:
             return None
+        t_wall = time.time()
         t0 = time.monotonic()
         arrays = _arrays(item)
-        t_decode[0] += time.monotonic() - t0
+        dt = time.monotonic() - t0
+        t_decode[0] += dt
+        if tracer is not None:
+            tracer.add_span(AGG_DECODE_TIME, t_wall, dt, parent=trace_parent,
+                            client_index=n_seen[0])
+        n_seen[0] += 1
         return arrays, n_cur
 
     first = _fetch_decode()
@@ -165,6 +180,7 @@ def aggregate_inplace(
             n_new = n_total + n_cur
             w_prev = n_total / n_new
             w_cur = n_cur / n_new
+            t_wall = time.time()
             t0 = time.monotonic()
             if pool is not None:
                 pool.map(
@@ -176,7 +192,10 @@ def aggregate_inplace(
             else:
                 for a, y in zip(acc, arrays):
                     _fold_into(a, y, w_prev, w_cur)
-            t_fold[0] += time.monotonic() - t0
+            dt = time.monotonic() - t0
+            t_fold[0] += dt
+            if tracer is not None:
+                tracer.add_span(AGG_FOLD_TIME, t_wall, dt, parent=trace_parent)
             n_total = n_new
     except BaseException:
         if pending is not None:

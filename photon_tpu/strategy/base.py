@@ -21,7 +21,16 @@ from typing import Any, Iterable
 import numpy as np
 
 from photon_tpu.strategy.aggregation import aggregate_inplace, weighted_average_metrics
-from photon_tpu.utils.profiling import AGG_DECODE_TIME, AGG_FOLD_TIME
+from photon_tpu.utils.profiling import (
+    AGG_DECODE_TIME,
+    AGG_FOLD_TIME,
+    EFFECTIVE_LR,
+    EVAL_LOSS,
+    N_CLIENTS,
+    N_SAMPLES,
+    PARAM_NORM,
+    PSEUDO_GRAD_NORM,
+)
 
 
 @dataclasses.dataclass
@@ -162,9 +171,9 @@ class Strategy:
         new_params = self.server_update(pseudo_grad, lr)
 
         metrics: dict[str, float] = {
-            "server/n_clients": float(n_clients),
-            "server/n_samples": float(n_total),
-            "server/effective_lr": lr,
+            N_CLIENTS: float(n_clients),
+            N_SAMPLES: float(n_total),
+            EFFECTIVE_LR: lr,
         }
         if self.telemetry:
             metrics.update(self.norm_telemetry(pseudo_grad))
@@ -181,7 +190,7 @@ class Strategy:
 
         loss = weighted_loss_avg([(n, l) for n, l, _ in results])
         metrics = weighted_average_metrics([(n, m) for n, l, m in results])
-        metrics["server/eval_loss"] = loss
+        metrics[EVAL_LOSS] = loss
         return loss, metrics
 
     # ------------------------------------------------------------------
@@ -193,8 +202,8 @@ class Strategy:
         per-layer + global norms, ``fedadam.py:333-381``; per-layer norms are
         computed on demand by callers to keep round metrics compact)."""
         out = {
-            "server/pseudo_grad_norm": l2_norm(pseudo_grad),
-            "server/param_norm": l2_norm(self.current_parameters or []),
+            PSEUDO_GRAD_NORM: l2_norm(pseudo_grad),
+            PARAM_NORM: l2_norm(self.current_parameters or []),
         }
         for key, tensors in self.state.items():
             out[f"server/{key}_norm"] = l2_norm(tensors)

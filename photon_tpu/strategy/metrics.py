@@ -14,6 +14,12 @@ from __future__ import annotations
 
 import numpy as np
 
+from photon_tpu.utils.profiling import (
+    GNS_SQNORM_EST,
+    GNS_TRACE_EST,
+    GRADIENT_NOISE_SCALE,
+)
+
 
 class GradientNoiseScale:
     def __init__(self, ema_alpha: float = 0.95) -> None:
@@ -48,11 +54,11 @@ class GradientNoiseScale:
         s_hat = self._ema_s / bias
         g2_hat = self._ema_g2 / bias
         out = {
-            "server/gns_trace_est": s_hat,
-            "server/gns_sqnorm_est": g2_hat,
+            GNS_TRACE_EST: s_hat,
+            GNS_SQNORM_EST: g2_hat,
         }
         if g2_hat > 0:
-            out["server/gradient_noise_scale"] = s_hat / g2_hat
+            out[GRADIENT_NOISE_SCALE] = s_hat / g2_hat
         return out
 
     # --- persistence across checkpoints ---

@@ -1,0 +1,177 @@
+"""Distributed round tracing + structured telemetry plane (``photon.telemetry``).
+
+PR 1–3 left the run's KPIs as server-side scalars: a stall inside a client
+fit, a slow transport leg, or a chaos-injected fault is invisible until it
+surfaces as a fat ``server/round_time``. This package attributes those
+seconds to phases, nodes, and rounds:
+
+- :mod:`spans` — a lightweight thread-safe :class:`Tracer`; trace context
+  rides every :class:`~photon_tpu.federation.messages.Envelope` so client
+  fit/eval spans parent to the server's round span across process
+  boundaries, and clients ship completed spans back piggybacked on
+  ``FitRes``/``EvaluateRes``;
+- :mod:`events` — a structured JSONL event log (membership transitions,
+  chaos injections, reconnects, corrupt-frame teardowns), each with trace
+  correlation;
+- :mod:`export` — a Perfetto/Chrome-trace exporter merging server + client
+  spans into one per-run timeline file;
+- :mod:`prom` — an optional stdlib-HTTP ``/metrics`` endpoint serving the
+  latest-round History KPIs in Prometheus text format.
+
+Installation discipline matches ``photon_tpu.chaos``: hook sites read one
+module global and do nothing when it is ``None`` — with
+``photon.telemetry.enabled=false`` (the default) the whole plane costs a
+``None`` check per site, no rng, no locks, no I/O.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterator
+
+from photon_tpu.telemetry.events import EventLog, read_events_jsonl
+from photon_tpu.telemetry.spans import Span, TraceContext, Tracer, new_id
+
+__all__ = [
+    "EventLog",
+    "Span",
+    "TraceContext",
+    "Tracer",
+    "active",
+    "attach",
+    "current_context",
+    "drain_events",
+    "emit_event",
+    "events_active",
+    "ingest",
+    "install",
+    "new_id",
+    "read_events_jsonl",
+    "span",
+    "uninstall",
+]
+
+_TRACER: Tracer | None = None
+_EVENTS: EventLog | None = None
+
+#: shared do-nothing context manager — the disabled-path ``span()`` return
+#: value, allocated once so the hook sites stay allocation-free
+_NULL_CM = contextlib.nullcontext()
+
+
+def install(cfg, scope: str = "", events_path: str | None = None,
+            piggyback: bool = False) -> Tracer | None:
+    """Install (or clear) the process-global tracer + event log from a
+    ``TelemetryConfig``.
+
+    ``cfg=None`` or ``cfg.enabled=False`` uninstalls — constructing a
+    ServerApp with telemetry off always leaves a clean process (the same
+    contract as ``chaos.install``). ``events_path`` switches the event log
+    to write-through JSONL (the server); without it events buffer and ride
+    the piggyback plane (nodes). ``piggyback`` marks the tracer's buffer as
+    drained-and-shipped by the node agent.
+    """
+    global _TRACER, _EVENTS
+    if cfg is None or not getattr(cfg, "enabled", False):
+        if _EVENTS is not None:
+            _EVENTS.close()
+        _TRACER = None
+        _EVENTS = None
+        return None
+    max_spans = int(getattr(cfg, "max_buffered_spans", 4096))
+    _TRACER = Tracer(scope, max_buffered_spans=max_spans, piggyback=piggyback)
+    if _EVENTS is not None:
+        _EVENTS.close()
+    _EVENTS = EventLog(scope, path=events_path, max_buffered=max_spans)
+    return _TRACER
+
+
+def uninstall() -> None:
+    global _TRACER, _EVENTS
+    if _EVENTS is not None:
+        _EVENTS.close()
+    _TRACER = None
+    _EVENTS = None
+
+
+def active() -> Tracer | None:
+    """The installed tracer, or None — the single check every hook makes."""
+    return _TRACER
+
+
+def events_active() -> EventLog | None:
+    return _EVENTS
+
+
+# -- hook-site helpers (each is a None check when disabled) ---------------
+
+def span(name: str, parent: TraceContext | None = None, **attrs: Any):
+    """Context manager: a span under the installed tracer, or a shared
+    no-op when telemetry is off."""
+    tr = _TRACER
+    if tr is None:
+        return _NULL_CM
+    return tr.span(name, parent=parent, **attrs)
+
+
+def current_context() -> TraceContext | None:
+    tr = _TRACER
+    return tr.current_context() if tr is not None else None
+
+
+def attach(ctx: TraceContext | None):
+    """Adopt a remote parent context (``Envelope.trace``) for a block."""
+    tr = _TRACER
+    if tr is None or not ctx:
+        return _NULL_CM
+    return tr.attach(ctx)
+
+
+def emit_event(kind: str, **attrs: Any) -> None:
+    """Record a structured event with trace correlation from the current
+    span (if any). No-op when telemetry is off."""
+    log = _EVENTS
+    if log is None:
+        return
+    log.emit(kind, attrs, ctx=current_context())
+
+
+def drain_events() -> list[dict]:
+    log = _EVENTS
+    return log.drain() if log is not None else []
+
+
+def ingest(spans: list[dict] | None = None,
+           events: list[dict] | None = None) -> None:
+    """Fold spans/events shipped from another process into this process's
+    tracer + event log (the server's merge points: fit/eval results,
+    broadcast acks, ping acks, stale drains). A None check when off."""
+    tr = _TRACER
+    if tr is not None and spans:
+        tr.ingest(spans)
+    log = _EVENTS
+    if log is not None and events:
+        log.ingest(events)
+
+
+def timed_add(name: str, **attrs: Any):
+    """Measure a block and record it as a completed span WITHOUT pushing it
+    on the context stack (transport legs: children should not parent to
+    them). Returns the shared no-op context when disabled — a single None
+    check, no generator allocation on the hot path."""
+    tr = _TRACER
+    if tr is None:
+        return _NULL_CM
+    return _timed_add_cm(tr, name, attrs)
+
+
+@contextlib.contextmanager
+def _timed_add_cm(tr: Tracer, name: str, attrs: dict) -> Iterator[None]:
+    import time as _time
+
+    t_wall = _time.time()
+    t0 = _time.perf_counter()
+    try:
+        yield
+    finally:
+        tr.add_span(name, t_wall, _time.perf_counter() - t0, **attrs)

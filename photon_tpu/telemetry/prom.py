@@ -1,0 +1,104 @@
+"""Prometheus text-format ``/metrics`` endpoint over the round History.
+
+Stdlib-only (the image has no prometheus_client, and the dependency rule
+forbids adding one): a ``ThreadingHTTPServer`` on a daemon thread serves
+the *latest-round* value of every History KPI in exposition format v0.0.4,
+plus ``photon_last_round`` so scrapes can tell staleness from stall.
+
+Metric names are sanitized KPI keys (``server/round_time`` →
+``photon_server_round_time``); everything is a gauge — round KPIs are
+point-in-time observations, and counters-by-convention
+(``server/wire_uplink_bytes``) stay per-round deltas exactly as recorded.
+
+Gated by ``photon.telemetry.prom_port`` (0 = off). Port 0 is also the
+bind-ephemeral spelling tests use directly on this class: the actual bound
+port is on :attr:`PromServer.port` after :meth:`start`.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def metric_name(key: str) -> str:
+    return "photon_" + _NAME_RE.sub("_", key)
+
+
+def render_history(history) -> str:
+    """Latest-round KPIs in Prometheus text format."""
+    lines: list[str] = []
+    last_round = -1
+    # snapshot in one C-level pass: the round loop inserts NEW keys as KPIs
+    # first appear, and iterating the live dict from the scrape thread would
+    # raise "dictionary changed size during iteration" mid-scrape
+    snapshot = list(history.rounds.items())
+    for key, series in sorted(snapshot):
+        if not series:
+            continue
+        rnd, value = series[-1]
+        last_round = max(last_round, int(rnd))
+        name = metric_name(key)
+        # plain gauges, no per-metric round label: a label whose value
+        # advances every round would mint a brand-new Prometheus series per
+        # round, fragmenting every query over time. photon_last_round below
+        # carries the round.
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {float(value):.10g}")
+    lines.append("# TYPE photon_last_round gauge")
+    lines.append(f"photon_last_round {last_round}")
+    return "\n".join(lines) + "\n"
+
+
+class PromServer:
+    """Serve ``GET /metrics`` for a live :class:`History` on a daemon
+    thread. The History is read under the GIL per scrape — record() appends
+    are atomic enough for a monitoring read (worst case: a scrape misses
+    the metric a concurrent record is mid-appending)."""
+
+    def __init__(self, history, port: int, host: str = "127.0.0.1") -> None:
+        self.history = history
+        self.host = host
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> int:
+        history = self.history
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_error(404)
+                    return
+                body = render_history(history).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:  # silence per-scrape stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="photon-prom", daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def close(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding
 
+from photon_tpu import telemetry
 from photon_tpu.codec import ParamsMetadata, params_from_ndarrays, params_to_ndarrays
 from photon_tpu.config.schema import Config
 from photon_tpu.models.mpt import MPTModel, init_params
@@ -35,6 +36,15 @@ from photon_tpu.train.train_step import (
     init_train_state,
     make_eval_step,
     make_train_step,
+)
+from photon_tpu.utils.profiling import (
+    CLIENT_FINAL_LOSS,
+    CLIENT_FIT_SET_PARAMETERS_TIME,
+    CLIENT_FIT_TIME,
+    CLIENT_LR,
+    CLIENT_STEPS,
+    CLIENT_TOKENS_PER_SEC,
+    SpeedMonitor,
 )
 
 
@@ -188,6 +198,23 @@ class Trainer:
         self._train_step = _train
         self._eval_step = _eval
 
+        # MFU/throughput monitor, peak auto-detected from THIS trainer's
+        # mesh devices (ISSUE 4 satellite: the old hardcoded v5e default
+        # mis-scaled MFU on every other chip); the chosen peak is recorded
+        # as a telemetry event so a run's MFU numbers carry their basis
+        mesh_devices = self.mesh.devices
+        self.speed_monitor = SpeedMonitor(
+            cfg.model,
+            n_chips=int(mesh_devices.size),
+            device_kind=getattr(mesh_devices.flat[0], "device_kind", ""),
+        )
+        telemetry.emit_event(
+            "speed_monitor/peak",
+            device_kind=self.speed_monitor.device_kind,
+            peak_flops_per_chip=self.speed_monitor.peak_flops_per_chip,
+            n_chips=self.speed_monitor.n_chips,
+        )
+
     # ------------------------------------------------------------------
     # auto microbatch probe
     # ------------------------------------------------------------------
@@ -334,12 +361,14 @@ class Trainer:
         dt = time.monotonic() - t0
         return {
             **last_metrics,
-            "client/fit_time": dt,
-            "client/fit_set_parameters_time": self._last_set_time,
-            "client/steps": float(duration_steps),
-            "client/tokens_per_sec": tokens_seen / dt if dt > 0 else 0.0,
-            "client/final_loss": losses[-1] if losses else float("nan"),
-            "client/lr": float(self.lr_schedule(self.step - 1)),
+            # throughput/mfu against the auto-detected chip peak (EMA'd)
+            **self.speed_monitor.update(tokens_seen, dt),
+            CLIENT_FIT_TIME: dt,
+            CLIENT_FIT_SET_PARAMETERS_TIME: self._last_set_time,
+            CLIENT_STEPS: float(duration_steps),
+            CLIENT_TOKENS_PER_SEC: tokens_seen / dt if dt > 0 else 0.0,
+            CLIENT_FINAL_LOSS: losses[-1] if losses else float("nan"),
+            CLIENT_LR: float(self.lr_schedule(self.step - 1)),
         }
 
     def evaluate(self, batches: Iterable[np.ndarray], max_batches: int = 0) -> dict[str, float]:

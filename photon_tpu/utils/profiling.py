@@ -22,6 +22,107 @@ from typing import Iterator
 from photon_tpu.config.schema import ModelConfig
 
 
+# ---------------------------------------------------------------------------
+# KPI name registry (ISSUE 4 satellite): every ``server/*`` / ``client/*``
+# metric name the runtime records into History is declared HERE as a module
+# constant — record sites import the constant, a registry test
+# (tests/test_telemetry.py) asserts no stringly-typed name drifts past this
+# file, and the tracing plane reuses the same constants as span names so
+# KPIs and spans agree on vocabulary.
+# ---------------------------------------------------------------------------
+
+# -- server round-loop phases (federation/server.py) ----------------------
+ROUND_TIME = "server/round_time"
+FIT_ROUND_TIME = "server/fit_round_time"
+BROADCAST_PRE_TIME = "server/broadcast_pre_time"
+BROADCAST_POST_TIME = "server/broadcast_post_time"
+CHECKPOINT_TIME = "server/checkpoint_time"
+CKPT_BARRIER_WAIT_S = "server/ckpt_barrier_wait_s"
+STEPS_CUMULATIVE = "server/steps_cumulative"
+ROUND_FAILED = "server/round_failed"
+EVAL_ROUND_FAILED = "server/eval_round_failed"
+# span-only phase names (no KPI twin: the KPI would duplicate round_time
+# decomposition already carried by the spans)
+SAMPLE_CLIENTS_SPAN = "server/sample_clients"
+EVAL_ROUND_SPAN = "server/eval_round"
+# whole-unit umbrella spans: deliberately NOT the KPI names — the KPI
+# server/round_time is measured from fit_round entry (excludes broadcast/
+# eval/checkpoint) and client/fit_time is the train loop alone, while these
+# spans cover the full round / full fit. A span may share a KPI's name ONLY
+# when it measures the same window.
+ROUND_SPAN = "server/round"
+CLIENT_FIT_SPAN = "client/fit"
+
+# -- server aggregation / strategy (strategy/base.py, metrics.py) ---------
+N_CLIENTS = "server/n_clients"
+N_SAMPLES = "server/n_samples"
+EFFECTIVE_LR = "server/effective_lr"
+EVAL_LOSS = "server/eval_loss"
+EVAL_SAMPLES = "server/eval_samples"
+PSEUDO_GRAD_NORM = "server/pseudo_grad_norm"
+PARAM_NORM = "server/param_norm"
+GNS_TRACE_EST = "server/gns_trace_est"
+GNS_SQNORM_EST = "server/gns_sqnorm_est"
+GRADIENT_NOISE_SCALE = "server/gradient_noise_scale"
+COLLECTIVE_AGG_TIME = "server/collective_agg_time"
+
+# -- wire / compression plane (WireStats.metrics_since) -------------------
+WIRE_UPLINK_RAW_BYTES = "server/wire_uplink_raw_bytes"
+WIRE_UPLINK_BYTES = "server/wire_uplink_bytes"
+WIRE_BROADCAST_BYTES = "server/wire_broadcast_bytes"
+WIRE_COMPRESSION_RATIO = "server/wire_compression_ratio"
+
+# -- client-side KPIs (train/trainer.py, federation/client_runtime.py) ----
+CLIENT_FIT_TIME = "client/fit_time"
+CLIENT_FIT_INIT_TIME = "client/fit_init_time"
+CLIENT_FIT_SET_PARAMETERS_TIME = "client/fit_set_parameters_time"
+CLIENT_STEPS = "client/steps"
+CLIENT_TOKENS_PER_SEC = "client/tokens_per_sec"
+CLIENT_FINAL_LOSS = "client/final_loss"
+CLIENT_LR = "client/lr"
+CLIENT_PSEUDO_GRAD_NORM = "client/pseudo_grad_norm"
+CLIENT_PARAM_NORM = "client/param_norm"
+CLIENT_SKIPPED_ROUND = "client/skipped_round"
+# span-only client phases (telemetry plane)
+CLIENT_RESOLVE_PARAMS_SPAN = "client/resolve_params"
+CLIENT_TRAIN_SPAN = "client/train"
+CLIENT_ENCODE_SPAN = "client/encode"
+CLIENT_PACKAGE_SPAN = "client/package"
+CLIENT_EVALUATE_SPAN = "client/evaluate"
+
+# -- transport-leg span names (federation/tcp.py; spans only, never KPIs) --
+TCP_SEND_SPAN = "tcp/send"
+TCP_RECV_SPAN = "tcp/recv"
+
+#: dynamic metric-name families the registry can't enumerate statically:
+#: per-strategy-state norms (``server/{state_key}_norm``,
+#: strategy/base.py:norm_telemetry). Patterns are re.fullmatch'd.
+DYNAMIC_METRIC_PATTERNS: tuple[str, ...] = (r"server/[A-Za-z0-9_]+_norm",)
+
+
+def registered_metric_names() -> frozenset:
+    """Every ``server/*`` / ``client/*`` name declared as a module constant
+    (the static half of the registry; see DYNAMIC_METRIC_PATTERNS)."""
+    import sys
+
+    mod = sys.modules[__name__]
+    return frozenset(
+        v
+        for k, v in vars(mod).items()
+        if isinstance(v, str)
+        and not k.startswith("_")
+        and (v.startswith("server/") or v.startswith("client/"))
+    )
+
+
+def is_registered_metric(name: str) -> bool:
+    import re
+
+    if name in registered_metric_names():
+        return True
+    return any(re.fullmatch(p, name) for p in DYNAMIC_METRIC_PATTERNS)
+
+
 # Host-plane round-pipeline KPI names (PR 2). Recorded into the round
 # metrics by the strategy / server so the History tracks where the host
 # seconds between device rounds actually go:
@@ -102,7 +203,9 @@ A100_PEAK_FLOPS = 312e12
 
 # bf16 peak FLOPs by device_kind substring (first match wins; most-specific
 # first). Used to turn tokens/sec into MFU for whatever chip the bench lands
-# on.
+# on — including GPU hosts (jax device_kind is e.g. "NVIDIA A100-SXM4-40GB"),
+# so SpeedMonitor's auto-detect doesn't quietly score a GPU against a TPU
+# peak. Unknown kinds (CPU, emulators) fall back to the documented default.
 PEAK_FLOPS_BY_DEVICE_KIND: list[tuple[str, float]] = [
     ("v6", 918e12),
     ("v5p", 459e12),
@@ -113,6 +216,8 @@ PEAK_FLOPS_BY_DEVICE_KIND: list[tuple[str, float]] = [
     ("v4", TPU_V4_PEAK_FLOPS),
     ("v3", 123e12),
     ("v2", 45e12),
+    ("h100", 989e12),  # SXM dense bf16
+    ("a100", A100_PEAK_FLOPS),
 ]
 
 
@@ -202,11 +307,32 @@ def model_flops_per_token(cfg: ModelConfig) -> float:
 
 class SpeedMonitor:
     """EMA tokens/sec + MFU (reference: llm-foundry ``speed_monitor``
-    callback, ``mpt-125m.yaml:98-109``)."""
+    callback, ``mpt-125m.yaml:98-109``).
 
-    def __init__(self, cfg: ModelConfig, peak_flops: float = TPU_V5E_PEAK_FLOPS,
-                 n_chips: int = 1, alpha: float = 0.9) -> None:
+    ``peak_flops=None`` (the default) auto-detects the bf16 peak from
+    ``device_kind`` — or, when that is also None, from
+    ``jax.devices()[0].device_kind`` — via :func:`peak_flops_for_device_kind`
+    (ISSUE 4 satellite: the old hardcoded-v5e default silently mis-scaled
+    MFU on every other chip). The resolved kind/peak are kept on
+    :attr:`device_kind` / :attr:`peak_flops_per_chip` so callers can record
+    the choice as a run attribute/event."""
+
+    def __init__(self, cfg: ModelConfig, peak_flops: float | None = None,
+                 n_chips: int = 1, alpha: float = 0.9,
+                 device_kind: str | None = None) -> None:
         self.flops_per_token = model_flops_per_token(cfg)
+        if peak_flops is None:
+            if device_kind is None:
+                try:
+                    import jax
+
+                    device_kind = jax.devices()[0].device_kind
+                except Exception:  # noqa: BLE001 — no backend yet: fall back
+                    device_kind = ""
+            peak_flops = peak_flops_for_device_kind(device_kind or "")
+        self.device_kind = device_kind or ""
+        self.peak_flops_per_chip = float(peak_flops)
+        self.n_chips = n_chips
         self.peak = peak_flops * n_chips
         self.alpha = alpha
         self._ema = 0.0
