@@ -1,7 +1,7 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test test-all bench bench-host bench-telemetry chaos telemetry-smoke native clean
+.PHONY: test test-all bench bench-host bench-telemetry chaos telemetry-smoke serve-smoke native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
@@ -34,6 +34,17 @@ bench-telemetry:
 telemetry-smoke:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_telemetry.py -q -m "slow or not slow"
+
+# serving smoke (ISSUE 5): the whole serving-plane suite — paged-cache
+# bit-parity with the contiguous decoder, scheduler invariants, HTTP
+# round-trips (blocking + chunked streaming) against a real round
+# checkpoint — then the serving bench, whose exit code asserts continuous
+# batching beats the batch-synchronous baseline on tokens/s at 16
+# concurrent ragged requests. All of it rides tier-1 too (none is slow).
+serve-smoke:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_serve.py -q -m "slow or not slow"
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --serving
 
 # the chaos-marked fault-injection + elasticity suite (incl. the slow
 # SIGKILL/rejoin e2es): deterministic — every test pins

@@ -1495,6 +1495,108 @@ def telemetry_overhead_report(n_rounds: int = 12, spin_calls: int = 200_000) -> 
         return None
 
 
+def serving_report(concurrency=(1, 4, 16), n_slots: int = 4,
+                   seed: int = 0) -> dict | None:
+    """Continuous batching vs batch-synchronous serving (ISSUE 5): tokens/s
+    and mean TTFT at 1/4/16 concurrent ragged requests on a tiny CPU model.
+
+    Same engine (and therefore the same compiled step) drives both
+    policies; only the batcher's admission rule differs — batch-synchronous
+    waits for a whole wave of slots to drain before admitting the next,
+    continuous refills freed slots mid-flight. Requests are deliberately
+    ragged (prompt 4-24, max_new 4-64 tokens) so waves are dominated by
+    their slowest member: the refill win IS the report. Requests run
+    greedy, so both modes produce identical tokens — only scheduling
+    differs. A warmup request absorbs the jit compiles before timing."""
+    try:
+        from photon_tpu.config.schema import Config
+        from photon_tpu.models.mpt import init_params
+        from photon_tpu.serve.engine import PagedEngine
+        from photon_tpu.serve.scheduler import ContinuousBatcher
+
+        cfg = Config()
+        cfg.model.d_model = 32
+        cfg.model.n_layers = 2
+        cfg.model.n_heads = 2
+        cfg.model.max_seq_len = 128
+        cfg.model.vocab_size = 64
+        cfg.model.attn_impl = "xla"
+        cfg.model.compute_dtype = "float32"
+        cfg.photon.serve.n_slots = n_slots
+        cfg.photon.serve.block_size = 8
+        cfg.photon.serve.max_new_tokens = 64
+        cfg.validate()
+        engine = PagedEngine(cfg, init_params(cfg.model, seed=4))
+
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        max_k = max(concurrency)
+        # decode-heavy ragged mix (max_new 4-64 ≫ prompt): real serving
+        # amortizes admission under many decode steps — a prefill-dominated
+        # mix would measure admission cost (identical in both modes), not
+        # the scheduling policy under test. The wide max_new spread is what
+        # batch-synchronous waves pay for: every wave runs at its slowest
+        # member's length
+        requests = [
+            (list(map(int, rng.integers(1, cfg.model.vocab_size,
+                                        int(rng.integers(4, 25))))),
+             int(rng.integers(4, 65)))
+            for _ in range(max_k)
+        ]
+
+        def run_mode(batch_synchronous: bool, k: int) -> dict:
+            batcher = ContinuousBatcher(
+                engine, max_queue=max_k + 1,
+                batch_synchronous=batch_synchronous,
+            ).start()
+            try:
+                t0 = time.perf_counter()
+                reqs = [batcher.submit(p, n) for p, n in requests[:k]]
+                outs = [r.result(timeout=300) for r in reqs]
+                wall = time.perf_counter() - t0
+            finally:
+                batcher.close()
+            tokens = sum(len(o) for o in outs)
+            return {
+                "tokens": tokens,
+                "tokens_per_s": round(tokens / wall, 2),
+                "ttft_mean_s": round(sum(r.ttft_s for r in reqs) / len(reqs), 5),
+                "wall_s": round(wall, 4),
+            }
+
+        # warmup OUTSIDE the clock: the full request set once, so every
+        # prompt-length bucket's prefill (and the step/sampler) is compiled
+        # before any timed run — the first cold mode otherwise eats every
+        # compile and the comparison measures jit order, not scheduling
+        run_mode(False, max_k)
+
+        out: dict = {"n_slots": n_slots, "concurrency": {}}
+        for k in concurrency:
+            # ABBA(x1.5) + best-of per mode (same discipline as the
+            # telemetry report): scheduler-noise on a 1-core host dwarfs
+            # the real delta, and the fastest run is each mode's
+            # least-perturbed observation
+            runs = {"continuous": [], "batch_synchronous": []}
+            for sync in (False, True, True, False, False, True):
+                runs["batch_synchronous" if sync else "continuous"].append(
+                    run_mode(sync, k)
+                )
+            out["concurrency"][str(k)] = {
+                mode: min(rs, key=lambda r: r["wall_s"])
+                for mode, rs in runs.items()
+            }
+        top = out["concurrency"][str(max_k)]
+        base = top["batch_synchronous"]["tokens_per_s"]
+        out["speedup_at_max_concurrency"] = (
+            round(top["continuous"]["tokens_per_s"] / base, 3) if base else None
+        )
+        return out
+    except Exception as e:  # noqa: BLE001 — never cost the round its numbers
+        log(f"serving report failed: {type(e).__name__}: {e}")
+        return None
+
+
 # ---------------------------------------------------------------------------
 # The actual bench (child process)
 # ---------------------------------------------------------------------------
@@ -1835,6 +1937,15 @@ def run(platform: str) -> None:
             out["telemetry_overhead"] = to
             emit(out)
 
+    # serving-plane throughput (tiny CPU model, no device time): continuous
+    # batching vs batch-synchronous at ragged concurrency — tracks the
+    # train→serve loop's headline alongside the training numbers
+    if os.environ.get("PHOTON_BENCH_SKIP_SERVING") != "1":
+        sv = serving_report()
+        if sv is not None:
+            out["serving"] = sv
+            emit(out)
+
     # under the supervisor (PHOTON_BENCH_ORCHESTRATED) parity and the
     # evidence stages run in their own child processes with fresh relay
     # claims; inline execution remains for manual `--run` invocations
@@ -1959,6 +2070,11 @@ def main() -> int:
                     help="run only the telemetry-overhead report (tiny CPU "
                          "fed rounds, spans on vs off) and print "
                          "{'telemetry_overhead': ...}")
+    ap.add_argument("--serving", action="store_true",
+                    help="run only the serving report (continuous batching "
+                         "vs batch-synchronous, tiny CPU model) and print "
+                         "{'serving': ...}; exits nonzero unless continuous "
+                         "batching wins at max concurrency")
     ap.add_argument("--stage", choices=["parity", "conv", "gauntlet", "1b"],
                     help="run ONE parity/evidence stage in-process (own relay claim)")
     args = ap.parse_args()
@@ -1974,6 +2090,14 @@ def main() -> int:
         to = telemetry_overhead_report()
         emit({"telemetry_overhead": to})
         return 0 if to is not None else 1
+    if args.serving:
+        # host+CPU-jax work only — never claims a chip; the exit code is the
+        # serve-smoke acceptance gate (continuous must beat batch-sync)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sv = serving_report()
+        emit({"serving": sv})
+        speedup = (sv or {}).get("speedup_at_max_concurrency")
+        return 0 if sv is not None and speedup and speedup > 1.0 else 1
     if args.kernel_parity:
         parity = kernel_parity(full=True, sink=_parity_sink)
         emit(parity)

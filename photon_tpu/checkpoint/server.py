@@ -300,6 +300,18 @@ class ServerCheckpointManager:
         server_state = bytes_to_state(self.store.get(f"{prefix}/{STATE_FILE}"))
         return metadata, parameters, strategy_state, server_state
 
+    def load_round_params(
+        self, server_round: int
+    ) -> tuple[ParamsMetadata, list[np.ndarray]]:
+        """Params-only load for serving/eval consumers (ISSUE 5 satellite):
+        reads ONLY ``current_server_parameters.npz`` — no strategy momenta,
+        no pickled control state — so an inference engine never materializes
+        the dead Adam moments a full :meth:`load_round` would (2x the param
+        bytes for FedAdam/FedYogi runs)."""
+        self.wait_pending()  # never read a round a writer may still be landing
+        prefix = self._round_prefix(server_round)
+        return npz_to_arrays(self.store.get(f"{prefix}/{PARAMS_FILE}"))
+
     # -- GC / import -----------------------------------------------------
     def cleanup(self, keep: int, state_keys: tuple[str, ...] = ()) -> list[int]:
         """Delete all but the newest ``keep`` valid rounds; invalid (partial)

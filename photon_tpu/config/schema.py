@@ -295,6 +295,41 @@ class TelemetryConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Continuous-batching inference plane (``photon_tpu/serve``).
+
+    OFF by default (the same opt-in discipline as ``photon.chaos``/
+    ``photon.telemetry``): the serving CLI refuses to start on a config
+    with ``enabled=false`` unless the operator passes ``--enable`` — a
+    resolved TRAINING config can never be pointed at the serving entry by
+    accident. Enabled, ``python -m photon_tpu.serve`` loads a federated
+    run's latest server round checkpoint (params only — no dead optimizer
+    moments) into a paged-KV engine and serves ``/generate`` (blocking +
+    chunked streaming), ``/healthz`` and ``/metrics`` over stdlib HTTP.
+
+    Sizing: each sequence reserves ``ceil((prompt + max_new_tokens) /
+    block_size)`` blocks at admission (no mid-flight preemption — see
+    docs/serving.md for the math); ``n_blocks = 0`` auto-sizes the pool to
+    the worst case ``n_slots * ceil(max_seq_len / block_size)``.
+    """
+
+    enabled: bool = False
+    host: str = "127.0.0.1"
+    port: int = 0  # HTTP port; 0 = bind-ephemeral (tests)
+    n_slots: int = 4  # fixed decode batch width (continuous-batching slots)
+    block_size: int = 16  # KV-cache tokens per paged block
+    n_blocks: int = 0  # paged-pool size; 0 = auto (worst case, never blocks)
+    max_queue: int = 64  # admission queue bound; overflow → HTTP 429
+    max_new_tokens: int = 64  # per-request generation cap
+    # prefill/decode interleave: max prompt tokens prefilled per scheduler
+    # iteration before a decode step runs (a single over-budget prompt is
+    # still admitted — alone — so it can't deadlock; it just can't bring
+    # friends). Keeps one giant prompt from starving in-flight decodes.
+    prefill_token_budget: int = 2048
+    eos_id: int = -1  # default per-request EOS (-1 = none; requests may override)
+
+
+@dataclass
 class MembershipConfig:
     """Elastic node membership (``federation/membership.py``).
 
@@ -386,6 +421,7 @@ class PhotonConfig:
     membership: MembershipConfig = field(default_factory=MembershipConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
     save_path: str = "/tmp/photon_tpu"
 
 
@@ -573,6 +609,26 @@ class Config:
             )
         if mem.reconnect_max_attempts < 0:
             raise ValueError("membership.reconnect_max_attempts must be >= 0 (0 = unlimited)")
+        srv = self.photon.serve
+        if srv.n_slots < 1 or srv.block_size < 1:
+            raise ValueError(
+                f"serve needs n_slots >= 1 and block_size >= 1, got "
+                f"{srv.n_slots}/{srv.block_size}"
+            )
+        if srv.n_blocks < 0:
+            raise ValueError(f"serve.n_blocks must be >= 0 (0 = auto), got {srv.n_blocks}")
+        if srv.max_queue < 1 or srv.max_new_tokens < 1:
+            raise ValueError(
+                f"serve needs max_queue >= 1 and max_new_tokens >= 1, got "
+                f"{srv.max_queue}/{srv.max_new_tokens}"
+            )
+        if srv.prefill_token_budget < 1:
+            raise ValueError(
+                f"serve.prefill_token_budget must be >= 1, got "
+                f"{srv.prefill_token_budget}"
+            )
+        if not 0 <= srv.port <= 65535:
+            raise ValueError(f"serve.port must be in [0, 65535], got {srv.port}")
         tel = self.photon.telemetry
         if not 0 <= tel.prom_port <= 65535:
             raise ValueError(

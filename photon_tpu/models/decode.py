@@ -23,6 +23,8 @@ llm-foundry inherits from HF ``GenerationMixin`` (KV cache included).
 
 from __future__ import annotations
 
+import dataclasses
+import threading
 from typing import Any
 
 import flax.struct
@@ -226,6 +228,45 @@ def decode_step(params: dict, state: DecodeState, token: jax.Array,
     )
 
 
+# ---------------------------------------------------------------------------
+# Shared compile cache (ISSUE 5 satellite): the jitted prefill/step pair is
+# keyed by the MODEL CONFIG, not the decoder instance — params ride as traced
+# arguments, so repeated gauntlet/eval/serving constructions with identical
+# configs (and therefore identical param shapes) reuse one trace+compile
+# instead of re-tracing per instance. The config key is its dataclass field
+# tuple (all scalars/strings — hashable); an unhashable future field degrades
+# to per-instance jits rather than failing.
+# ---------------------------------------------------------------------------
+
+_JIT_PAIR_CACHE: dict[tuple, tuple[Any, Any]] = {}
+_JIT_PAIR_LOCK = threading.Lock()
+
+
+def _build_jit_pair(cfg: ModelConfig) -> tuple[Any, Any]:
+    prefill_jit = jax.jit(lambda p, t, l: prefill(p, t, l, cfg))
+    # donate the STATE (arg 1), never the params — params are shared across
+    # every request/instance using this config
+    step_jit = jax.jit(
+        lambda p, st, tok: decode_step(p, st, tok, cfg), donate_argnums=1
+    )
+    return prefill_jit, step_jit
+
+
+def decode_jit_pair(cfg: ModelConfig) -> tuple[Any, Any]:
+    """``(prefill_jit(params, tokens, lengths), step_jit(params, state,
+    token))`` shared module-wide per config value."""
+    try:
+        key = dataclasses.astuple(cfg)
+        hash(key)
+    except TypeError:
+        return _build_jit_pair(cfg)
+    with _JIT_PAIR_LOCK:
+        pair = _JIT_PAIR_CACHE.get(key)
+        if pair is None:
+            pair = _JIT_PAIR_CACHE[key] = _build_jit_pair(cfg)
+    return pair
+
+
 def generate(params: Any, tokens: jax.Array, lengths: jax.Array,
              cfg: ModelConfig, max_new_tokens: int, *,
              temperature: float = 0.0, top_k: int = 0,
@@ -234,9 +275,8 @@ def generate(params: Any, tokens: jax.Array, lengths: jax.Array,
     ``temperature == 0`` is greedy argmax (deterministic, the eval path);
     otherwise logits/temperature are sampled, optionally truncated to the
     ``top_k`` highest first (the sampling surface HF ``generate`` gives
-    reference users). For repeated calls hold a ``make_cached_generate_fn``
-    result instead — this builds (and re-traces) the jitted prefill/step
-    pair per invocation."""
+    reference users). Compiles are shared through :func:`decode_jit_pair`,
+    so repeated invocations with one config reuse the same traces."""
     fn = make_cached_generate_fn(cfg, params)
     return fn.many(tokens, lengths, max_new_tokens,
                    temperature=temperature, top_k=top_k, seed=seed)
@@ -254,17 +294,25 @@ def make_cached_generate_fn(cfg: ModelConfig, params: Any,
     one_step = (
         make_generate_fn(model_apply, params) if model_apply is not None else None
     )
-    prefill_jit = jax.jit(lambda t, l: prefill(params, t, l, cfg))
-    step_jit = jax.jit(
-        lambda st, tok: decode_step(params, st, tok, cfg), donate_argnums=0
-    )
+    # shared per-config compiles (params ride as traced args). device_put the
+    # leaves once: npz-loaded numpy params would otherwise re-transfer on
+    # every jitted call now that they are arguments instead of closure consts
+    params = jax.tree.map(jnp.asarray, params)
+    prefill_jit, step_jit = decode_jit_pair(cfg)
 
     def many(tokens, lengths, n: int, *, temperature: float = 0.0,
-             top_k: int = 0, seed: int = 0):
-        """Decode ``n`` tokens — greedy at ``temperature == 0`` (the eval
-        default), sampled otherwise. Enforces ``max(lengths) + n <= S`` —
-        past the buffer end the one-hot cache write would silently drop
-        k/v and decode from a stale cache."""
+             top_k: int = 0, seed: int = 0, eos_id: int | None = None):
+        """Decode up to ``n`` tokens — greedy at ``temperature == 0`` (the
+        eval default), sampled otherwise. Enforces ``max(lengths) + n <= S``
+        — past the buffer end the one-hot cache write would silently drop
+        k/v and decode from a stale cache.
+
+        ``eos_id`` arms per-row early exit: a row that emits ``eos_id``
+        (written — the EOS itself lands in the buffer) is frozen (no further
+        writes, its returned length stops growing) and the loop breaks as
+        soon as EVERY row is done instead of burning all ``n`` steps. The
+        all-done check is a per-step host sync, which is exactly the point:
+        trading one scalar readback per token for skipped decode steps."""
         if int(jnp.max(lengths)) + n > tokens.shape[1]:
             raise ValueError(
                 f"decode overflow: max length {int(jnp.max(lengths))} + "
@@ -281,14 +329,29 @@ def make_cached_generate_fn(cfg: ModelConfig, params: Any,
             return jax.random.categorical(key, scaled, axis=-1)
 
         key = jax.random.PRNGKey(seed)
-        logits, st = prefill_jit(tokens, lengths)
+        logits, st = prefill_jit(params, tokens, lengths)
+        done = None if eos_id is None else jnp.zeros(tokens.shape[0], bool)
+        produced = jnp.zeros_like(lengths)
         for i in range(n):
             key, sub = jax.random.split(key)
             nxt = pick(logits, sub).astype(tokens.dtype)
-            tokens = write_at_cursor(tokens, st.lengths, nxt)
+            if done is None:
+                tokens = write_at_cursor(tokens, st.lengths, nxt)
+            else:
+                # done-mask freeze: finished rows keep their buffer bytes
+                # (their cache cursor still advances inside step_jit, but
+                # nothing they produce is observable)
+                tokens = jnp.where(done[:, None], tokens,
+                                   write_at_cursor(tokens, st.lengths, nxt))
+                produced = produced + jnp.where(done, 0, 1)
+                done = done | (nxt == eos_id)
+                if i < n - 1 and bool(jnp.all(done)):
+                    break
             if i < n - 1:  # the last token's successor logits are unused
-                logits, st = step_jit(st, nxt)
-        return tokens, jnp.minimum(lengths + n, tokens.shape[1])
+                logits, st = step_jit(params, st, nxt)
+        if done is None:
+            produced = jnp.full_like(lengths, n)
+        return tokens, jnp.minimum(lengths + produced, tokens.shape[1])
 
     class _GenerateFn:
         """Callable wrapper (jitted functions reject attribute assignment)."""

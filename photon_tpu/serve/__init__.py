@@ -1,0 +1,45 @@
+"""Continuous-batching inference plane (``photon.serve``, ISSUE 5).
+
+Closes the train→serve loop: after four PRs of federation, aggregation,
+checkpointing and tracing, this package loads a federated run's server
+round checkpoint and answers prompts with it.
+
+Four layers, each testable alone:
+
+- :mod:`cache` — the paged KV pool: fixed block pool + per-slot block
+  tables + free-list recycling, with a gather-based decode step that is
+  bit-exact with the contiguous ``models/decode.py`` greedy path;
+- :mod:`engine` — the jit'd fixed-shape slot engine (admission never
+  retraces), params-only checkpoint loading, per-request greedy/seeded
+  sampling;
+- :mod:`scheduler` — the continuous batcher: bounded admission queue with
+  reject-not-buffer backpressure, FIFO admission, mid-flight eviction +
+  refill, prefill/decode interleave budget, ``serve/*`` KPIs + request
+  spans;
+- :mod:`frontend` — stdlib HTTP ``/generate`` (blocking + chunked
+  streaming), ``/healthz``, Prometheus ``/metrics``.
+
+Run one: ``python -m photon_tpu.serve --config run.yaml --enable`` (or
+``--preset`` + ``--store/--run`` for an existing federated run's store).
+
+Everything is OFF by default — the CLI refuses a config with
+``photon.serve.enabled=false`` unless ``--enable`` opts in — and nothing
+in the training stack imports this package: training configs never pay
+for the serving plane.
+"""
+
+from photon_tpu.serve.cache import BlockAllocator, PagedState, paged_decode_step
+from photon_tpu.serve.engine import PagedEngine
+from photon_tpu.serve.frontend import ServeFrontend
+from photon_tpu.serve.scheduler import ContinuousBatcher, QueueFullError, ServeRequest
+
+__all__ = [
+    "BlockAllocator",
+    "ContinuousBatcher",
+    "PagedEngine",
+    "PagedState",
+    "QueueFullError",
+    "ServeFrontend",
+    "ServeRequest",
+    "paged_decode_step",
+]

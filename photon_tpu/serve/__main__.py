@@ -1,0 +1,114 @@
+"""Serving CLI — load a round checkpoint, serve it.
+
+Serving is opt-in (``photon.serve.enabled`` defaults to false): a resolved
+TRAINING config can't be pointed at this entry by accident — enable it in
+the config, or pass ``--enable`` to opt in from the command line.
+
+Examples::
+
+    # serve the latest round of a federated run
+    python -m photon_tpu.serve --config /runs/my-run/resolved.yaml \
+        --enable --port 8000
+
+    # explicit store/run/round + a text tokenizer
+    python -m photon_tpu.serve --preset mpt-125m --store /runs/store \
+        --run my-run --round -1 --enable --port 8000 --tokenizer byte-fallback
+
+    curl -s localhost:8000/generate -d '{"tokens": [5, 9, 2], "max_new_tokens": 8}'
+    curl -sN localhost:8000/generate -d '{"text": "hi", "stream": true}'
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import threading
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="photon_tpu.serve", description="serve a checkpoint over HTTP"
+    )
+    ap.add_argument("--config", default=None, help="resolved config YAML")
+    ap.add_argument("--preset", default="mpt-125m")
+    ap.add_argument("--store", default=None,
+                    help="object-store root (default: {photon.save_path}/store)")
+    ap.add_argument("--run", default=None, help="run_uuid (default: config's)")
+    ap.add_argument("--round", type=int, default=-1,
+                    help="server round (negative = latest valid)")
+    ap.add_argument("--enable", action="store_true",
+                    help="opt in to serving when the config leaves "
+                         "photon.serve.enabled=false")
+    ap.add_argument("--host", default=None)
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--tokenizer", default=None,
+                    help="enable 'text' prompts (e.g. byte-fallback, gpt2)")
+    args = ap.parse_args(argv)
+
+    from photon_tpu import telemetry
+    from photon_tpu.checkpoint import FileStore
+    from photon_tpu.config import load_preset
+    from photon_tpu.config.schema import Config
+    from photon_tpu.serve.engine import PagedEngine
+    from photon_tpu.serve.frontend import ServeFrontend
+    from photon_tpu.serve.scheduler import ContinuousBatcher
+
+    cfg = Config.from_yaml(args.config) if args.config else load_preset(args.preset)
+    if args.run:
+        cfg.run_uuid = args.run
+    sc = cfg.photon.serve
+    if args.enable:
+        sc.enabled = True
+    if not sc.enabled:
+        raise SystemExit(
+            "serving is off in this config (photon.serve.enabled=false) — "
+            "enable it there or pass --enable"
+        )
+    if args.host:
+        sc.host = args.host
+    if args.port is not None:
+        sc.port = args.port
+    cfg.validate()
+    if cfg.photon.telemetry.enabled:
+        telemetry.install(cfg.photon.telemetry, scope="serve")
+
+    store = FileStore(args.store) if args.store else None
+    engine = PagedEngine.from_checkpoint(cfg, store=store, resume_round=args.round)
+    batcher = ContinuousBatcher(
+        engine,
+        max_queue=sc.max_queue,
+        prefill_token_budget=sc.prefill_token_budget,
+        default_eos_id=sc.eos_id if sc.eos_id >= 0 else None,
+    ).start()
+    tokenizer = None
+    if args.tokenizer:
+        from photon_tpu.data.tokenizer import load_tokenizer
+
+        tokenizer = load_tokenizer(args.tokenizer)
+    frontend = ServeFrontend(
+        batcher, host=sc.host, port=sc.port,
+        max_new_tokens_cap=sc.max_new_tokens, tokenizer=tokenizer,
+    )
+    port = frontend.start()
+    print(json.dumps({
+        "serving": f"http://{sc.host}:{port}",
+        "round": engine.loaded_round,
+        "model": cfg.model.name,
+        "n_slots": engine.n_slots,
+        "n_blocks": engine.n_blocks,
+        "block_size": engine.block_size,
+    }), flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    try:
+        stop.wait()
+    finally:
+        frontend.close()
+        batcher.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,259 @@
+"""Paged KV cache — block-pool storage for the serving plane.
+
+The contiguous :class:`~photon_tpu.models.decode.DecodeState` allocates
+``[B, S, H_kv, Dh]`` per layer per sequence — a 12-token prompt in a
+2048-token buffer pays for 2048 rows. The serving engine instead keeps ONE
+fixed pool of KV blocks shared by every slot (the Ragged Paged Attention
+shape, PAPERS.md arxiv 2604.15464):
+
+- **pool**: ``cache_k/cache_v`` of shape ``[n_blocks + 1, L, block_size,
+  H_kv, Dh]``. The LAST block is the trash block — never allocated, it
+  absorbs the fixed-shape writes of empty slots so the jitted step needs no
+  per-slot control flow.
+- **block tables**: ``[n_slots, max_blocks]`` int32 mapping each slot's
+  logical block ``j`` (tokens ``[j*bs, (j+1)*bs)``) to a physical pool
+  block; unassigned entries point at the trash block.
+- **free list**: a host-side :class:`BlockAllocator` recycles physical
+  blocks between requests (allocation policy — reserve-at-admission — lives
+  in the scheduler; this module only enforces no-double-alloc/free).
+
+:func:`paged_decode_step` mirrors ``models/decode.py:decode_step`` op for
+op — same RoPE/ALiBi math, same grouped-query einsums, same masking — with
+the contiguous cache replaced by a block-table gather and the one-hot
+cache write replaced by a scatter at ``(physical_block, offset)``. Masked
+positions contribute exactly-zero probability either way, so greedy decode
+through the paged pool is bit-exact with the contiguous path
+(``tests/test_serve.py`` pins logits AND tokens with assert_array_equal).
+
+TPU note: the pool's layer axis sits second (``[N, L, bs, H, D]`` — block
+major, so a block is one contiguous alloc unit); the step scans layers via
+a ``moveaxis`` view, which XLA folds into the gather. Kernel-level ragged
+paged attention (the Pallas route) would replace the gather+dense-attend
+here without touching the scheduler above it.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.config.schema import ModelConfig
+from photon_tpu.models.decode import _dense, _embed, _logits, _mlp, _norm, _qkv, _rope_at
+from photon_tpu.ops.attention import alibi_slopes
+
+
+class BlockLeakError(RuntimeError):
+    """Double-free / foreign-id free — a block-accounting bug, never user error."""
+
+
+class BlockAllocator:
+    """Host-side free list over physical block ids ``[0, n_blocks)``.
+
+    LIFO recycling (a just-freed block is the next handed out) keeps the
+    hot working set small. Guards double-free and foreign ids: the
+    scheduler's no-leak invariant is only as strong as this accounting.
+    """
+
+    def __init__(self, n_blocks: int) -> None:
+        if n_blocks < 1:
+            raise ValueError(f"need n_blocks >= 1, got {n_blocks}")
+        self.n_blocks = n_blocks
+        self._free: list[int] = list(range(n_blocks - 1, -1, -1))
+        self._held: set[int] = set()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """``n`` physical ids, or None (and NO partial allocation) when the
+        pool can't cover the request."""
+        if n < 0:
+            raise ValueError(f"need n >= 0, got {n}")
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        self._held.update(ids)
+        return ids
+
+    def free(self, ids: list[int]) -> None:
+        for b in ids:
+            if b not in self._held:
+                raise BlockLeakError(f"freeing block {b} not currently held")
+            self._held.remove(b)
+            self._free.append(b)
+
+
+@flax.struct.dataclass
+class PagedState:
+    """Device-side serving state — every array fixed-shape so the engine's
+    step jit never retraces on admission/eviction."""
+
+    cache_k: jax.Array  # [n_blocks + 1, L, block_size, H_kv, Dh]
+    cache_v: jax.Array
+    block_tables: jax.Array  # [n_slots, max_blocks] int32 physical ids
+    lengths: jax.Array  # [n_slots] int32 per-slot token counts
+
+    @property
+    def block_size(self) -> int:
+        return self.cache_k.shape[2]
+
+    @property
+    def trash_block(self) -> int:
+        return self.cache_k.shape[0] - 1
+
+    @property
+    def n_slots(self) -> int:
+        return self.block_tables.shape[0]
+
+
+def init_paged_state(cfg: ModelConfig, n_slots: int, n_blocks: int,
+                     block_size: int, max_blocks: int) -> PagedState:
+    n_kv = cfg.n_kv_heads or cfg.n_heads
+    dtype = jnp.dtype(cfg.compute_dtype)
+    shape = (n_blocks + 1, cfg.n_layers, block_size, n_kv, cfg.d_head)
+    return PagedState(
+        cache_k=jnp.zeros(shape, dtype),
+        cache_v=jnp.zeros(shape, dtype),
+        block_tables=jnp.full((n_slots, max_blocks), n_blocks, jnp.int32),
+        lengths=jnp.zeros((n_slots,), jnp.int32),
+    )
+
+
+def write_prefill_blocks(state: PagedState, slot: int, block_ids: list[int],
+                         cache_k: jax.Array, cache_v: jax.Array,
+                         length: int) -> PagedState:
+    """Scatter a contiguous prefill cache (``[L, 1, S_pad, H_kv, Dh]`` from
+    ``models/decode.py:prefill`` — so prefill numerics stay pinned by the
+    existing parity tests) into ``len(block_ids)`` pool blocks and point
+    ``slot``'s table at them.
+
+    Only the blocks covering the prompt need rows here; reserved blocks
+    beyond them are listed in the table but written lazily by the decode
+    step — position ``p`` is always scattered before any step reads it
+    (``valid`` admits ``p`` exactly at the step that writes it)."""
+    bs = state.block_size
+    n_pb = len(block_ids)
+    need = -(-length // bs)  # ceil: blocks that actually hold prompt rows
+    if need > n_pb:
+        raise ValueError(f"{n_pb} blocks cannot hold a {length}-token prompt")
+    if cache_k.shape[2] < need * bs:
+        raise ValueError(
+            f"prefill cache covers {cache_k.shape[2]} rows < {need * bs} needed"
+        )
+    L = cache_k.shape[0]
+    ids = jnp.asarray(block_ids[:need], jnp.int32) if need else None
+    if need:
+        # [L, 1, S, H, D] → [L, need, bs, H, D] → block-major [need, L, bs, H, D]
+        kb = cache_k[:, 0, : need * bs].reshape(L, need, bs, *cache_k.shape[3:])
+        vb = cache_v[:, 0, : need * bs].reshape(L, need, bs, *cache_v.shape[3:])
+        ck = state.cache_k.at[ids].set(kb.swapaxes(0, 1).astype(state.cache_k.dtype))
+        cv = state.cache_v.at[ids].set(vb.swapaxes(0, 1).astype(state.cache_v.dtype))
+    else:
+        ck, cv = state.cache_k, state.cache_v
+    row = jnp.full((state.block_tables.shape[1],), state.trash_block, jnp.int32)
+    row = row.at[: n_pb].set(jnp.asarray(block_ids, jnp.int32)) if n_pb else row
+    return PagedState(
+        cache_k=ck,
+        cache_v=cv,
+        block_tables=state.block_tables.at[slot].set(row),
+        lengths=state.lengths.at[slot].set(length),
+    )
+
+
+def admit_write(state: PagedState, slot: jax.Array, row_ids: jax.Array,
+                cache_k: jax.Array, cache_v: jax.Array,
+                length: jax.Array) -> PagedState:
+    """Jit-friendly admission writer (the engine compiles this once per
+    prompt-length bucket): scatter EVERY prefill block of ``cache_k/v``
+    (``[L, 1, S_pad, H_kv, Dh]``) through ``row_ids [max_blocks]`` and
+    install the row as ``slot``'s table.
+
+    Unlike :func:`write_prefill_blocks` (the op-by-op host reference, which
+    scatters exactly the blocks the prompt needs), every shape here is
+    static: padding blocks past the reservation simply route to the trash
+    block — ``row_ids``'s tail is the trash id — so the garbage rows the
+    bucketed prefill computed land where idle-slot writes already go."""
+    bs = state.block_size
+    L = cache_k.shape[0]
+    n_pad = cache_k.shape[2] // bs
+    kb = cache_k[:, 0, : n_pad * bs].reshape(L, n_pad, bs, *cache_k.shape[3:])
+    vb = cache_v[:, 0, : n_pad * bs].reshape(L, n_pad, bs, *cache_v.shape[3:])
+    targets = row_ids[:n_pad]
+    return PagedState(
+        cache_k=state.cache_k.at[targets].set(
+            kb.swapaxes(0, 1).astype(state.cache_k.dtype)),
+        cache_v=state.cache_v.at[targets].set(
+            vb.swapaxes(0, 1).astype(state.cache_v.dtype)),
+        block_tables=state.block_tables.at[slot].set(row_ids),
+        lengths=state.lengths.at[slot].set(length),
+    )
+
+
+def paged_decode_step(params: dict, state: PagedState, token: jax.Array,
+                      cfg: ModelConfig,
+                      active: jax.Array) -> tuple[jax.Array, PagedState]:
+    """One decode step over ALL slots: place ``token [n_slots]`` at each
+    ACTIVE slot's cursor (inactive slots write into the trash block and
+    don't advance), attend through the block tables, return (logits
+    ``[n_slots, V]``, advanced state). Mirrors ``decode_step`` exactly —
+    see the module docstring for the bit-exactness argument."""
+    n_kv = cfg.n_kv_heads or cfg.n_heads
+    group = cfg.n_heads // n_kv
+    bs = state.block_size
+    n_slots, m = state.block_tables.shape
+    s = m * bs
+    pos = state.lengths  # [B] — where this token lands
+    x = _embed(params, token, pos, cfg)  # [B, D]
+    scale = 1.0 / (cfg.d_head ** 0.5)
+    k_pos = jnp.arange(s)[None, :]  # [1, S]
+    valid = (k_pos <= pos[:, None])  # j <= pos, per row (garbage masked)
+    # physical write target per row. INACTIVE rows route to the trash block
+    # regardless of their table: eviction is then pure host bookkeeping (no
+    # table reset), and a stale row left by a failed admission can never
+    # write into since-recycled blocks. clip keeps an idle cursor from
+    # indexing past the table.
+    blk = jnp.minimum(pos // bs, m - 1)
+    off = pos % bs
+    phys = jnp.take_along_axis(state.block_tables, blk[:, None], axis=1)[:, 0]
+    phys = jnp.where(active, phys, state.trash_block)
+
+    ck_l = jnp.moveaxis(state.cache_k, 1, 0)  # [L, NB, bs, H, D] view
+    cv_l = jnp.moveaxis(state.cache_v, 1, 0)
+
+    def layer(x, xs):
+        lp, ck, cv = xs  # ck/cv: [NB, bs, H_kv, Dh] — this layer's pool
+        h = _norm(x, lp["ln_1"]["scale"], lp["ln_1"].get("bias"),
+                  cfg.norm, cfg.norm_eps)
+        q, k_new, v_new = _qkv(lp, h, cfg)  # q [B,H,Dh], k/v [B,Hkv,Dh]
+        if cfg.rope:
+            q = _rope_at(q[:, None], pos[:, None], cfg.rope_theta)[:, 0]
+            k_new = _rope_at(k_new[:, None], pos[:, None], cfg.rope_theta)[:, 0]
+        ck = ck.at[phys, off].set(k_new.astype(ck.dtype))
+        cv = cv.at[phys, off].set(v_new.astype(cv.dtype))
+        # block-table gather → the slot's logical [S, H, D] view
+        gk = ck[state.block_tables].reshape(n_slots, s, n_kv, cfg.d_head)
+        gv = cv[state.block_tables].reshape(n_slots, s, n_kv, cfg.d_head)
+        qg = q.reshape(q.shape[0], n_kv, group, cfg.d_head)
+        scores = jnp.einsum("bkgd,bskd->bkgs", qg, gk,
+                            preferred_element_type=jnp.float32) * scale
+        if cfg.alibi:
+            dist = (pos[:, None] - k_pos).astype(jnp.float32)  # [B, S]
+            slopes = alibi_slopes(cfg.n_heads).reshape(n_kv, group)
+            scores = scores - slopes[None, :, :, None] * dist[:, None, None, :]
+        scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(gv.dtype), gv)
+        x = x + _dense(lp, "out_proj", out.reshape(x.shape[0], cfg.d_model))
+        return _mlp(lp, x, cfg), (ck, cv)
+
+    x, (ck_l, cv_l) = jax.lax.scan(
+        layer, x, (params["blocks"]["block"], ck_l, cv_l)
+    )
+    return _logits(params, x, cfg), PagedState(
+        cache_k=jnp.moveaxis(ck_l, 0, 1),
+        cache_v=jnp.moveaxis(cv_l, 0, 1),
+        block_tables=state.block_tables,
+        lengths=state.lengths + active.astype(jnp.int32),
+    )

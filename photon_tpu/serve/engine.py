@@ -1,0 +1,239 @@
+"""The jit'd serving engine: fixed-shape slot arrays over the paged pool.
+
+One :class:`PagedEngine` owns the device state (paged KV pool, block
+tables, per-slot cursors/temperatures/PRNG keys) and three compiled
+programs:
+
+- the shared prefill from :func:`models.decode.decode_jit_pair` (one trace
+  per prompt-length bucket — prompts pad to a power-of-two block count, so
+  at most ``log2(max_blocks)+1`` compiles ever happen);
+- ``_step``: one :func:`~photon_tpu.serve.cache.paged_decode_step` +
+  per-slot sampling over ALL ``n_slots`` slots, fixed shapes throughout —
+  admission and eviction never retrace (eviction is pure host bookkeeping:
+  the step trash-routes idle slots' writes, so stale tables are inert);
+- ``_admit_write``: the one-call admission scatter
+  (:func:`~photon_tpu.serve.cache.admit_write`, per prompt bucket) —
+  op-by-op host writes cost ~10 dispatches per admission on a 1-core host.
+
+Sampling is per request: ``temperature == 0`` rows take argmax (bit-exact
+with the offline greedy path), others sample from seeded per-slot PRNG
+streams (same seed → same completion, independent of batch-mates).
+
+Params come either straight from a pytree or — the train→serve loop — via
+:meth:`from_checkpoint`: ``ServerCheckpointManager.load_round_params`` (the
+params-only path: no dead Adam moments), momenta split off for
+momenta-aggregating runs, leaves restored onto the model template.
+
+Thread-discipline: ONE driver thread (the scheduler loop) calls
+admit/step/evict; HTTP handler threads only read the scalar stats. The
+step donates the previous state, so the pool is updated in place.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.config.schema import Config, ModelConfig
+from photon_tpu.models.decode import decode_jit_pair
+from photon_tpu.serve.cache import (
+    BlockAllocator,
+    PagedState,
+    admit_write,
+    init_paged_state,
+    paged_decode_step,
+)
+
+
+def _sample_rows(logits: jax.Array, temps: jax.Array,
+                 keys: jax.Array) -> jax.Array:
+    """Per-row greedy/temperature sampling: ``temps[b] == 0`` → argmax."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+    return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+
+
+_sample_jit = jax.jit(_sample_rows)
+
+
+class PagedEngine:
+    def __init__(self, cfg: Config, params: Any, *,
+                 loaded_round: int | None = None) -> None:
+        self.cfg = cfg
+        self.mc: ModelConfig = cfg.model
+        sc = cfg.photon.serve
+        self.block_size = sc.block_size
+        self.n_slots = sc.n_slots
+        self.max_blocks = -(-self.mc.max_seq_len // self.block_size)
+        self.s_cap = self.max_blocks * self.block_size
+        self.n_blocks = sc.n_blocks or self.n_slots * self.max_blocks
+        self.loaded_round = loaded_round
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.allocator = BlockAllocator(self.n_blocks)
+        self.state: PagedState = init_paged_state(
+            self.mc, self.n_slots, self.n_blocks, self.block_size, self.max_blocks
+        )
+        self._keys = jnp.zeros((self.n_slots, 2), jnp.uint32)
+        self._temps = jnp.zeros((self.n_slots,), jnp.float32)
+        self._last = np.zeros(self.n_slots, np.int32)  # last emitted token
+        self._active = np.zeros(self.n_slots, bool)
+        self._slot_blocks: list[list[int]] = [[] for _ in range(self.n_slots)]
+        self._prefill_jit, _ = decode_jit_pair(self.mc)
+        mc = self.mc
+
+        def step_fn(params, state, tokens, active, temps, keys):
+            logits, state = paged_decode_step(params, state, tokens, mc, active)
+            sub = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+            nxt = _sample_rows(logits, temps, sub[:, 0])
+            nxt = jnp.where(active, nxt, 0)
+            return state, nxt, sub[:, 1]
+
+        self._step = jax.jit(step_fn, donate_argnums=(1, 5))
+        # admission as ONE compiled program (donating the state): the
+        # op-by-op host scatter costs ~10 dispatches per admission on a
+        # 1-core host, which would tax BOTH sides of the serving bench
+        self._admit_write = jax.jit(admit_write, donate_argnums=0)
+
+    # -- checkpoint loading ----------------------------------------------
+    @classmethod
+    def from_checkpoint(cls, cfg: Config, store: Any | None = None,
+                        resume_round: int = -1) -> "PagedEngine":
+        """Serve a federated run directly: resolve the (checksum-valid)
+        round, load params ONLY, split off aggregated momenta if the run
+        shipped them, restore onto the model template."""
+        from photon_tpu.checkpoint import FileStore
+        from photon_tpu.checkpoint.server import ServerCheckpointManager
+        from photon_tpu.codec import params_from_ndarrays
+        from photon_tpu.models.mpt import init_params
+        from photon_tpu.train.param_ops import has_momenta, split_momenta
+
+        store = store or FileStore(cfg.photon.save_path + "/store")
+        mgr = ServerCheckpointManager(store, cfg.run_uuid)
+        rnd = mgr.resolve_resume_round(resume_round)
+        meta, arrays = mgr.load_round_params(rnd)
+        if has_momenta(meta):
+            meta, arrays, _, _ = split_momenta(meta, arrays)
+        params = params_from_ndarrays(init_params(cfg.model, seed=0), meta, arrays)
+        return cls(cfg, params, loaded_round=rnd)
+
+    # -- capacity ---------------------------------------------------------
+    def blocks_needed(self, prompt_len: int, max_new: int) -> int:
+        return -(-(prompt_len + max_new) // self.block_size)
+
+    def fits(self, prompt_len: int, max_new: int) -> bool:
+        """Static admissibility: can this request EVER run here? Bounded by
+        the model's context window (``s_cap >= max_seq_len`` always, but a
+        learned-wpe model has no positions past ``max_seq_len``)."""
+        return (prompt_len >= 1
+                and prompt_len + max_new <= min(self.s_cap, self.mc.max_seq_len)
+                # ... and by the POOL size: a user-shrunk n_blocks smaller
+                # than one request's reservation must reject at SUBMIT time,
+                # or the request would queue behind a can_admit() that can
+                # never pass and FIFO head-block the queue forever
+                and self.blocks_needed(prompt_len, max_new)
+                <= min(self.max_blocks, self.n_blocks))
+
+    def can_admit(self, prompt_len: int, max_new: int) -> bool:
+        return (self.free_slot() is not None
+                and self.allocator.free_blocks
+                >= self.blocks_needed(prompt_len, max_new))
+
+    def free_slot(self) -> int | None:
+        idle = np.flatnonzero(~self._active)
+        return int(idle[0]) if idle.size else None
+
+    @property
+    def n_active(self) -> int:
+        return int(self._active.sum())
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks
+
+    # -- admission / step / eviction --------------------------------------
+    def _bucket(self, prompt_len: int) -> int:
+        """Prompt pad width: power-of-two BLOCK count (so the shared prefill
+        compiles at most log2(max_blocks)+1 distinct shapes), capped at the
+        slot capacity."""
+        need = max(1, -(-prompt_len // self.block_size))
+        return min(1 << (need - 1).bit_length(), self.max_blocks) * self.block_size
+
+    def admit(self, slot: int, prompt: list[int], max_new: int,
+              temperature: float = 0.0, seed: int = 0) -> int:
+        """Prefill ``prompt`` into ``slot``'s reserved blocks and return the
+        request's FIRST generated token. Reserves the worst case
+        ``blocks_needed(len, max_new)`` up front — an admitted request can
+        never die of pool exhaustion mid-flight (the no-preemption design;
+        docs/serving.md)."""
+        if self._active[slot]:
+            raise RuntimeError(f"slot {slot} is occupied")
+        n = len(prompt)
+        if not self.fits(n, max_new):
+            raise ValueError(
+                f"request needs {n}+{max_new} tokens > slot capacity {self.s_cap}"
+            )
+        ids = self.allocator.alloc(self.blocks_needed(n, max_new))
+        if ids is None:
+            raise RuntimeError("paged pool exhausted (caller must can_admit first)")
+        try:
+            s_pad = max(self._bucket(n), n)
+            tokens = np.zeros((1, s_pad), np.int32)
+            tokens[0, :n] = prompt
+            lengths = jnp.asarray([n], jnp.int32)
+            logits, cst = self._prefill_jit(self.params, jnp.asarray(tokens), lengths)
+            row_ids = np.full(self.max_blocks, self.n_blocks, np.int32)
+            row_ids[: len(ids)] = ids
+            self.state = self._admit_write(
+                self.state, jnp.int32(slot), jnp.asarray(row_ids),
+                cst.cache_k, cst.cache_v, jnp.int32(n),
+            )
+            sub, carry = jax.random.split(jax.random.PRNGKey(seed))
+            first = int(_sample_jit(
+                logits, jnp.asarray([temperature], jnp.float32), sub[None]
+            )[0])
+        except BaseException:
+            # transactional: a failed admission must not leak its blocks.
+            # A partially-written table row is harmless — the decode step
+            # trash-routes every INACTIVE slot's writes, and re-admission
+            # overwrites the row
+            self.allocator.free(ids)
+            raise
+        self._keys = self._keys.at[slot].set(carry)
+        self._temps = self._temps.at[slot].set(float(temperature))
+        self._slot_blocks[slot] = ids
+        self._active[slot] = True
+        self._last[slot] = first
+        return first
+
+    def step(self) -> np.ndarray:
+        """One decode step for every active slot; returns next token ids
+        ``[n_slots]`` (zeros at inactive slots — callers mask by activity).
+        Each active slot's previously-emitted token is placed at its cursor,
+        so the returned ids are each sequence's NEXT token."""
+        if not self._active.any():
+            raise RuntimeError("no active slots")
+        active = jnp.asarray(self._active)
+        self.state, nxt, self._keys = self._step(
+            self.params, self.state, jnp.asarray(self._last),
+            active, self._temps, self._keys,
+        )
+        out = np.asarray(nxt)
+        self._last = np.where(self._active, out, self._last).astype(np.int32)
+        return out
+
+    def evict(self, slot: int) -> None:
+        """Return ``slot``'s blocks to the free list — pure host
+        bookkeeping: the decode step trash-routes inactive slots' writes,
+        so the stale table row needs no device-side reset, and recycled
+        pool bytes are NOT cleared (the valid-mask makes stale rows
+        unreadable)."""
+        if not self._active[slot]:
+            raise RuntimeError(f"slot {slot} is not active")
+        self.allocator.free(self._slot_blocks[slot])
+        self._slot_blocks[slot] = []
+        self._active[slot] = False
+        self._last[slot] = 0

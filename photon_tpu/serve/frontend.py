@@ -1,0 +1,219 @@
+"""Stdlib-HTTP serving frontend: ``/generate``, ``/healthz``, ``/metrics``.
+
+Same dependency discipline as ``telemetry/prom.py`` (the image has no web
+framework and the rule forbids adding one): a ``ThreadingHTTPServer`` whose
+handler threads block on the batcher's per-request output queues — the
+scheduler's single driver thread does all engine work.
+
+``POST /generate`` accepts JSON::
+
+    {"tokens": [1, 2, 3],          # prompt token ids, OR
+     "text": "...",                # tokenized server-side (needs a tokenizer)
+     "max_new_tokens": 32,         # capped by photon.serve.max_new_tokens
+     "temperature": 0.0,           # 0 = greedy (bit-exact with offline)
+     "seed": 0,                    # sampling stream seed
+     "eos_id": 256,                # per-request EOS (default: photon.serve)
+     "stream": false}
+
+Blocking responses return one JSON object (generated ids + phase timings).
+``"stream": true`` switches to HTTP/1.1 chunked transfer: one JSON line
+per token as it is decoded (``{"token": id}``), then a final stats line —
+curl-friendly SSE-less streaming. Queue overflow maps to **429** with a
+``Retry-After`` hint, the backpressure contract of the bounded admission
+queue. ``/metrics`` renders the batcher's KPI History through
+``telemetry/prom.py``'s exposition writer, so the serve plane's
+``serve/*`` gauges scrape exactly like the training plane's.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from photon_tpu.serve.scheduler import (
+    ContinuousBatcher,
+    QueueFullError,
+    serve_history_kpis,
+)
+from photon_tpu.telemetry.prom import render_history
+
+
+class ServeFrontend:
+    """HTTP face over a running :class:`ContinuousBatcher`."""
+
+    def __init__(self, batcher: ContinuousBatcher, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_new_tokens_cap: int = 64,
+                 tokenizer: Any | None = None,
+                 request_timeout_s: float = 120.0) -> None:
+        self.batcher = batcher
+        self.host = host
+        self.port = port
+        self.max_new_tokens_cap = max_new_tokens_cap
+        self.tokenizer = tokenizer
+        self.request_timeout_s = request_timeout_s
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> int:
+        fe = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # chunked transfer needs 1.1 (and 1.1 keep-alive needs correct
+            # Content-Length on every non-chunked response — _json sets it)
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args) -> None:  # silence per-request stderr
+                pass
+
+            # ---- helpers ----
+            def _json(self, code: int, obj: dict,
+                      extra_headers: dict | None = None) -> None:
+                body = (json.dumps(obj) + "\n").encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _chunk(self, data: bytes) -> None:
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+
+            # ---- routes ----
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                path = self.path.rstrip("/")
+                if path == "/healthz":
+                    eng = fe.batcher.engine
+                    self._json(200, {
+                        "status": "ok",
+                        "round": eng.loaded_round,
+                        "model": eng.mc.name,
+                        "slots_free": eng.n_slots - eng.n_active,
+                        "blocks_free": eng.free_blocks,
+                        "queue_depth": fe.batcher.queue_depth,
+                        "completed": fe.batcher.completed,
+                        "rejected": fe.batcher.rejected,
+                        "kpis": serve_history_kpis(fe.batcher.history),
+                    })
+                elif path == "/metrics":
+                    body = render_history(fe.batcher.history).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json(404, {"error": f"no route {self.path!r}"})
+
+            def do_POST(self) -> None:  # noqa: N802 — http.server API
+                if self.path.rstrip("/") != "/generate":
+                    self._json(404, {"error": f"no route {self.path!r}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._json(400, {"error": f"bad JSON body: {e}"})
+                    return
+                try:
+                    prompt = fe._resolve_prompt(body)
+                    max_new = min(int(body.get("max_new_tokens", fe.max_new_tokens_cap)),
+                                  fe.max_new_tokens_cap)
+                    eos = body.get("eos_id")
+                    req = fe.batcher.submit(
+                        prompt, max_new,
+                        temperature=float(body.get("temperature", 0.0)),
+                        seed=int(body.get("seed", 0)),
+                        eos_id=None if eos is None else int(eos),
+                    )
+                except QueueFullError as e:
+                    self._json(429, {"error": str(e)}, {"Retry-After": "1"})
+                    return
+                except (TypeError, ValueError, RuntimeError) as e:
+                    # TypeError: un-coercible field types (e.g. a list for
+                    # eos_id) must be a 400, not a dropped connection
+                    self._json(400, {"error": str(e)})
+                    return
+                if body.get("stream"):
+                    self._stream(req)
+                else:
+                    self._blocking(req)
+
+            def _blocking(self, req) -> None:
+                try:
+                    tokens = req.result(timeout=fe.request_timeout_s)
+                except Exception as e:  # noqa: BLE001 — surface, don't hang
+                    self._json(500, {"error": str(e)})
+                    return
+                self._json(200, fe._result_payload(req, tokens))
+
+            def _stream(self, req) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonl")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for tok in req.stream(timeout=fe.request_timeout_s):
+                        self._chunk((json.dumps({"token": int(tok)}) + "\n").encode())
+                    final = fe._result_payload(req, req.generated)
+                except Exception as e:  # noqa: BLE001 — close the stream honestly
+                    final = {"error": str(e)}
+                final["done"] = True
+                self._chunk((json.dumps(final) + "\n").encode())
+                self.wfile.write(b"0\r\n\r\n")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="photon-serve-http", daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def close(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- request plumbing -------------------------------------------------
+    def _resolve_prompt(self, body: dict) -> list[int]:
+        if body.get("tokens") is not None:
+            toks = body["tokens"]
+            vocab = self.batcher.engine.mc.vocab_size
+            if not isinstance(toks, list) or not all(
+                isinstance(t, int) and 0 <= t < vocab for t in toks
+            ):
+                raise ValueError(
+                    f"'tokens' must be a list of ints in [0, {vocab})"
+                )
+            return toks
+        if body.get("text") is not None:
+            if self.tokenizer is None:
+                raise ValueError(
+                    "'text' prompts need a server-side tokenizer; send 'tokens'"
+                )
+            return list(self.tokenizer.encode(body["text"]))
+        raise ValueError("need 'tokens' or 'text'")
+
+    def _result_payload(self, req, tokens: list[int]) -> dict:
+        out = {
+            "tokens": [int(t) for t in tokens],
+            "n_prompt": len(req.prompt),
+            "n_generated": len(req.generated),
+            "ttft_s": round(req.ttft_s, 6),
+            "total_s": round(max(0.0, req.t_done - req.t_submit), 6),
+        }
+        if self.tokenizer is not None:
+            out["text"] = self.tokenizer.decode(tokens)
+        return out

@@ -1,0 +1,370 @@
+"""Continuous batching: bounded admission queue + slot-level scheduling.
+
+The serving loop (one driver thread) interleaves two phases forever:
+
+1. **admit** — pop FIFO from the bounded queue into free slots while the
+   paged pool can cover each request's worst-case block reservation, up to
+   ``prefill_token_budget`` prompt tokens per iteration (one over-budget
+   prompt still admits alone — the budget bounds *batching* of prefills,
+   not admissibility, so a giant prompt can't starve in-flight decodes);
+2. **decode** — ONE fixed-shape engine step for every active slot; rows
+   that hit their EOS or ``max_new_tokens`` are evicted immediately and
+   their blocks/slot recycled, so the next iteration's admit phase refills
+   mid-flight. That refill is the whole tokens/s win over batch-synchronous
+   serving (``bench.py --serving`` measures it).
+
+Backpressure is reject-not-buffer: :meth:`ContinuousBatcher.submit` raises
+:class:`QueueFullError` when ``max_queue`` requests are already waiting —
+the HTTP frontend maps it to 429 so load sheds at the edge instead of
+growing an unbounded deque. Admission is strictly FIFO: a head request
+that doesn't fit (no slot / not enough free blocks) BLOCKS later arrivals
+rather than being overtaken (no starvation of big requests).
+
+Telemetry: per-request ``serve/request`` umbrella spans with
+queue/prefill/decode children (emitted at completion into the installed
+tracer, if any), and the ``serve/*`` KPIs from the registry recorded into
+a :class:`History` every scheduler tick — rendered by the frontend's
+``/metrics`` via ``telemetry/prom.py``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from photon_tpu import telemetry
+from photon_tpu.metrics.history import History
+from photon_tpu.serve.engine import PagedEngine
+from photon_tpu.utils.profiling import (
+    SERVE_DECODE_SPAN,
+    SERVE_EVICTIONS,
+    SERVE_PREFILL_SPAN,
+    SERVE_QUEUE_DEPTH,
+    SERVE_QUEUE_SPAN,
+    SERVE_REJECTED,
+    SERVE_REQUEST_SPAN,
+    SERVE_SLOT_OCCUPANCY,
+    SERVE_TOKENS_PER_S,
+    SERVE_TTFT_S,
+)
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at ``max_queue`` — the HTTP frontend's 429."""
+
+
+@dataclass
+class ServeRequest:
+    """One generation request and its streaming output channel."""
+
+    rid: int
+    prompt: list[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    seed: int = 0
+    eos_id: int | None = None
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first: float = 0.0
+    t_done: float = 0.0
+    generated: list[int] = field(default_factory=list)
+    error: str | None = None
+    finished: bool = False
+    _out: "queue.Queue[int | None]" = field(default_factory=queue.Queue)
+
+    def stream(self, timeout: float = 60.0):
+        """Yield generated token ids as they land; StopIteration on finish.
+        Raises RuntimeError if the request failed server-side."""
+        while True:
+            tok = self._out.get(timeout=timeout)
+            if tok is None:
+                if self.error:
+                    raise RuntimeError(self.error)
+                return
+            yield tok
+
+    def result(self, timeout: float = 60.0) -> list[int]:
+        """Block until completion; the full generated-token list."""
+        for _ in self.stream(timeout=timeout):
+            pass
+        return self.generated
+
+    @property
+    def ttft_s(self) -> float:
+        return max(0.0, self.t_first - self.t_submit)
+
+
+class ContinuousBatcher:
+    """Single-driver-thread scheduler over a :class:`PagedEngine`.
+
+    ``batch_synchronous=True`` is the BASELINE policy for the serving
+    bench: admission waits until every slot is empty, then fills all slots
+    and runs the wave to completion (classic static batching). Continuous
+    mode (default) refills freed slots mid-flight.
+    """
+
+    def __init__(self, engine: PagedEngine, *, max_queue: int = 64,
+                 prefill_token_budget: int = 2048,
+                 default_eos_id: int | None = None,
+                 batch_synchronous: bool = False,
+                 history: History | None = None) -> None:
+        self.engine = engine
+        self.max_queue = max_queue
+        self.prefill_token_budget = prefill_token_budget
+        self.default_eos_id = default_eos_id
+        self.batch_synchronous = batch_synchronous
+        self.history = history if history is not None else History()
+        self._queue: deque[ServeRequest] = deque()
+        self._running: dict[int, ServeRequest] = {}  # slot -> request
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        self._rid = itertools.count()
+        self._tick = 0
+        # cumulative counters (read by /healthz and the KPI tick)
+        self.rejected = 0
+        self.evictions = 0
+        self.completed = 0
+        # FIFO-audit ring (tests assert order); bounded — a serving daemon
+        # must not grow per-request state forever
+        self.admitted_order: deque[int] = deque(maxlen=4096)
+        #: per-KPI History cap: /metrics only ever renders the LATEST value,
+        #: so old ticks are trimmed rather than accumulating ~50 tuples/s
+        #: of resident growth for the lifetime of the server
+        self.max_kpi_ticks = 4096
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "ContinuousBatcher":
+        self._thread = threading.Thread(
+            target=self._loop, name="photon-serve-batcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self, timeout: float = 30.0) -> None:
+        with self._work:
+            self._stop = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    # -- submission (any thread) ------------------------------------------
+    def submit(self, prompt: list[int], max_new_tokens: int, *,
+               temperature: float = 0.0, seed: int = 0,
+               eos_id: int | None = None) -> ServeRequest:
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if not self.engine.fits(len(prompt), max_new_tokens):
+            raise ValueError(
+                f"request needs {len(prompt)}+{max_new_tokens} tokens — over "
+                f"this server's context capacity"
+            )
+        # eos_id: None → server default; negative → explicitly no EOS
+        eos = self.default_eos_id if eos_id is None else (
+            None if eos_id < 0 else int(eos_id)
+        )
+        req = ServeRequest(
+            rid=next(self._rid), prompt=list(prompt),
+            max_new_tokens=max_new_tokens, temperature=temperature, seed=seed,
+            eos_id=eos, t_submit=time.monotonic(),
+        )
+        with self._work:
+            if self._stop:
+                raise RuntimeError("batcher is shut down")
+            if len(self._queue) >= self.max_queue:
+                self.rejected += 1
+                raise QueueFullError(
+                    f"admission queue full ({self.max_queue} waiting)"
+                )
+            self._queue.append(req)
+            self._work.notify_all()
+        return req
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def stats(self) -> dict[str, float]:
+        with self._lock:
+            return {
+                SERVE_QUEUE_DEPTH: float(len(self._queue)),
+                SERVE_SLOT_OCCUPANCY: len(self._running) / self.engine.n_slots,
+                SERVE_EVICTIONS: float(self.evictions),
+                SERVE_REJECTED: float(self.rejected),
+            }
+
+    # -- driver loop -------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._work:
+                while not self._stop and not self._queue and not self._running:
+                    self._work.wait(timeout=0.5)
+                if self._stop:
+                    break
+            try:
+                self._admit_phase()
+                self._decode_phase()
+            except Exception as e:  # noqa: BLE001 — fail loudly, not silently
+                self._fail_all(f"{type(e).__name__}: {e}")
+            self._record_tick()
+        self._drain_on_stop()
+
+    def _admit_phase(self) -> None:
+        budget = self.prefill_token_budget
+        admitted_any = False
+        # batch-sync baseline: a wave may only START from an empty engine,
+        # but once open it fills EVERY slot this phase (admissions made
+        # here keep n_active > 0 — checking n_active per iteration would
+        # degrade the baseline to one-request-at-a-time serial serving)
+        wave_open = self.engine.n_active == 0
+        while True:
+            with self._lock:
+                head = self._queue[0] if self._queue else None
+            if head is None:
+                return
+            if self.batch_synchronous and not wave_open:
+                return  # baseline: wait for the whole wave to drain
+            if admitted_any and budget < len(head.prompt):
+                return  # interleave: give decode a turn before more prefills
+            slot = self.engine.free_slot()
+            if slot is None or not self.engine.can_admit(
+                len(head.prompt), head.max_new_tokens
+            ):
+                return  # FIFO head-blocking: nobody overtakes
+            with self._lock:
+                req = self._queue.popleft()
+            req.t_admit = time.monotonic()
+            try:
+                first = self.engine.admit(
+                    slot, req.prompt, req.max_new_tokens,
+                    temperature=req.temperature, seed=req.seed,
+                )
+            except Exception as e:  # noqa: BLE001 — fail THIS request, keep serving
+                # engine.admit is transactional (blocks freed, slot released)
+                # — only this request dies, and its client gets the error
+                # instead of a timeout
+                req.finished = True
+                req.error = f"admission failed: {type(e).__name__}: {e}"
+                req.t_first = req.t_done = time.monotonic()
+                self._emit_spans(req)
+                req._out.put(None)
+                continue
+            req.t_first = time.monotonic()
+            self.admitted_order.append(req.rid)
+            with self._lock:
+                self._running[slot] = req
+            budget -= len(req.prompt)
+            admitted_any = True
+            self._push_token(slot, req, first)
+
+    def _decode_phase(self) -> None:
+        with self._lock:
+            slots = sorted(self._running)
+        if not slots:
+            return
+        t0 = time.monotonic()
+        nxt = self.engine.step()
+        dt = time.monotonic() - t0
+        n_tokens = 0
+        for slot in slots:
+            req = self._running.get(slot)
+            if req is None or req.finished:
+                continue
+            n_tokens += 1
+            self._push_token(slot, req, int(nxt[slot]))
+        if dt > 0 and n_tokens:
+            self.history.record(self._tick, {SERVE_TOKENS_PER_S: n_tokens / dt})
+
+    def _push_token(self, slot: int, req: ServeRequest, tok: int) -> None:
+        req.generated.append(tok)
+        req._out.put(tok)
+        if (req.eos_id is not None and tok == req.eos_id) \
+                or len(req.generated) >= req.max_new_tokens:
+            self._finish(slot, req)
+
+    def _finish(self, slot: int, req: ServeRequest,
+                error: str | None = None) -> None:
+        req.finished = True
+        req.error = error
+        req.t_done = time.monotonic()
+        self.engine.evict(slot)
+        with self._lock:
+            self._running.pop(slot, None)
+            self.evictions += 1
+            if error is None:
+                self.completed += 1
+        if error is None:
+            self.history.record(self._tick, {SERVE_TTFT_S: req.ttft_s})
+        self._emit_spans(req)
+        req._out.put(None)
+
+    def _fail_all(self, msg: str) -> None:
+        """An engine error poisons every in-flight request (their cache
+        state is unknown) — fail them loudly and keep serving the queue."""
+        with self._lock:
+            running = list(self._running.items())
+        for slot, req in running:
+            self._finish(slot, req, error=msg)
+
+    def _drain_on_stop(self) -> None:
+        with self._lock:
+            queued, self._queue = list(self._queue), deque()
+            running = list(self._running.items())
+        for slot, req in running:
+            self._finish(slot, req, error="server shutting down")
+        for req in queued:
+            req.finished = True
+            req.error = "server shutting down"
+            req._out.put(None)
+
+    # -- telemetry ---------------------------------------------------------
+    def _record_tick(self) -> None:
+        self._tick += 1
+        self.history.record(self._tick, self.stats())
+        for series in self.history.rounds.values():
+            if len(series) > self.max_kpi_ticks:
+                del series[: len(series) - self.max_kpi_ticks]
+
+    def _emit_spans(self, req: ServeRequest) -> None:
+        """Request phases as completed spans: a ``serve/request`` umbrella
+        with queue/prefill/decode children. Wall-epoch anchored at emit
+        time (phase boundaries were captured on the monotonic clock)."""
+        tr = telemetry.active()
+        if tr is None:
+            return
+        now_wall, now_mono = time.time(), time.monotonic()
+
+        def wall(t_mono: float) -> float:
+            return now_wall - (now_mono - t_mono)
+
+        umbrella = tr.add_span(
+            SERVE_REQUEST_SPAN, wall(req.t_submit), req.t_done - req.t_submit,
+            rid=req.rid, n_prompt=len(req.prompt), n_generated=len(req.generated),
+            error=req.error or "",
+        )
+        parent = (umbrella.trace_id, umbrella.span_id)
+        for name, a, b in (
+            (SERVE_QUEUE_SPAN, req.t_submit, req.t_admit or req.t_done),
+            (SERVE_PREFILL_SPAN, req.t_admit, req.t_first),
+            (SERVE_DECODE_SPAN, req.t_first, req.t_done),
+        ):
+            if a and b >= a:
+                tr.add_span(name, wall(a), b - a, parent=parent, rid=req.rid)
+
+
+def serve_history_kpis(history: History) -> dict[str, float]:
+    """Latest value of every serve KPI in ``history`` (healthz payload)."""
+    return {
+        k: v
+        for k in (SERVE_TTFT_S, SERVE_TOKENS_PER_S, SERVE_QUEUE_DEPTH,
+                  SERVE_SLOT_OCCUPANCY, SERVE_EVICTIONS, SERVE_REJECTED)
+        if (v := history.latest(k)) is not None
+    }

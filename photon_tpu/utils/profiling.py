@@ -94,6 +94,28 @@ CLIENT_EVALUATE_SPAN = "client/evaluate"
 TCP_SEND_SPAN = "tcp/send"
 TCP_RECV_SPAN = "tcp/recv"
 
+# -- serving plane (photon_tpu/serve, ISSUE 5) ----------------------------
+# KPIs the continuous batcher records into its own History (exported via
+# telemetry/prom.py's exposition renderer on the frontend's /metrics):
+#: seconds from request admission-queue entry to its FIRST streamed token
+SERVE_TTFT_S = "serve/ttft_s"
+#: decoded tokens/sec across the slot batch over the last scheduler tick
+SERVE_TOKENS_PER_S = "serve/tokens_per_s"
+#: admission-queue depth at tick time (backpressure: full queue → HTTP 429)
+SERVE_QUEUE_DEPTH = "serve/queue_depth"
+#: fraction of decode slots occupied at tick time
+SERVE_SLOT_OCCUPANCY = "serve/slot_occupancy"
+#: cumulative finished sequences evicted from slots (EOS / length cap)
+SERVE_EVICTIONS = "serve/evictions"
+#: cumulative requests rejected at admission (queue full → 429)
+SERVE_REJECTED = "serve/rejected"
+# span-only request phases (telemetry plane): the per-request umbrella and
+# its queue/prefill/decode children, emitted at request completion
+SERVE_REQUEST_SPAN = "serve/request"
+SERVE_QUEUE_SPAN = "serve/queue"
+SERVE_PREFILL_SPAN = "serve/prefill"
+SERVE_DECODE_SPAN = "serve/decode"
+
 #: dynamic metric-name families the registry can't enumerate statically:
 #: per-strategy-state norms (``server/{state_key}_norm``,
 #: strategy/base.py:norm_telemetry). Patterns are re.fullmatch'd.
@@ -101,8 +123,9 @@ DYNAMIC_METRIC_PATTERNS: tuple[str, ...] = (r"server/[A-Za-z0-9_]+_norm",)
 
 
 def registered_metric_names() -> frozenset:
-    """Every ``server/*`` / ``client/*`` name declared as a module constant
-    (the static half of the registry; see DYNAMIC_METRIC_PATTERNS)."""
+    """Every ``server/*`` / ``client/*`` / ``serve/*`` name declared as a
+    module constant (the static half of the registry; see
+    DYNAMIC_METRIC_PATTERNS)."""
     import sys
 
     mod = sys.modules[__name__]
@@ -111,7 +134,8 @@ def registered_metric_names() -> frozenset:
         for k, v in vars(mod).items()
         if isinstance(v, str)
         and not k.startswith("_")
-        and (v.startswith("server/") or v.startswith("client/"))
+        and (v.startswith("server/") or v.startswith("client/")
+             or v.startswith("serve/"))
     )
 
 

@@ -186,6 +186,76 @@ def test_generate_sampling_modes():
         assert first[b] in topk_ids[b], (first[b], topk_ids[b])
 
 
+def test_many_eos_early_exit():
+    """ISSUE 5 satellite: rows that emit ``eos_id`` freeze — the EOS lands
+    in the buffer, nothing after it is written, per-row lengths stop —
+    and the stream prefix matches the unfrozen run exactly."""
+    from photon_tpu.models.decode import make_cached_generate_fn
+    from photon_tpu.models.mpt import init_params
+
+    cfg = _mpt_cfg(alibi=False)
+    params = init_params(cfg.model, seed=4)
+    fn = make_cached_generate_fn(cfg.model, params)
+    rng = np.random.default_rng(2)
+    tokens = np.zeros((3, 20), np.int32)
+    lengths = np.asarray([4, 6, 9], np.int32)
+    for i, ln in enumerate(lengths):
+        tokens[i, :ln] = rng.integers(1, cfg.model.vocab_size, ln)
+    gen = 8
+
+    full, _ = fn.many(jnp.asarray(tokens), jnp.asarray(lengths), gen)
+    full = np.asarray(full)
+    # pick an EOS every row emits (so the batch CAN fully finish early):
+    # each row's first generated token works iff shared; else fall back to
+    # row 0's and only row 0 freezes
+    streams = [list(full[i, lengths[i]:lengths[i] + gen]) for i in range(3)]
+    eos = int(streams[0][0])
+
+    got, got_len = fn.many(jnp.asarray(tokens), jnp.asarray(lengths), gen,
+                           eos_id=eos)
+    got = np.asarray(got)
+    for i in range(3):
+        s = streams[i]
+        cut = s.index(eos) + 1 if eos in s else gen
+        np.testing.assert_array_equal(
+            got[i, lengths[i]:lengths[i] + cut], s[:cut])
+        # frozen tail: untouched buffer (zeros), not post-EOS tokens
+        np.testing.assert_array_equal(got[i, lengths[i] + cut:], 0)
+        assert int(got_len[i]) == int(lengths[i]) + cut
+
+
+def test_many_eos_all_done_first_step():
+    """Every row EOSes at its first token → produced lengths are +1 and the
+    rest of the buffer stays untouched regardless of ``n``."""
+    from photon_tpu.models.decode import make_cached_generate_fn
+    from photon_tpu.models.mpt import init_params
+
+    cfg = _mpt_cfg(alibi=False)
+    params = init_params(cfg.model, seed=4)
+    fn = make_cached_generate_fn(cfg.model, params)
+    tokens = jnp.zeros((2, 64), jnp.int32).at[:, :3].set(5)
+    lengths = jnp.asarray([3, 3], jnp.int32)
+    probe, _ = fn.many(tokens, lengths, 1)
+    eos = int(np.asarray(probe)[0, 3])  # both rows: same prompt, same token
+
+    got, got_len = fn.many(tokens, lengths, 60, eos_id=eos)
+    got = np.asarray(got)
+    assert list(np.asarray(got_len)) == [4, 4]
+    np.testing.assert_array_equal(got[:, 4:], 0)
+
+
+def test_decode_jit_pair_shared_across_instances():
+    """ISSUE 5 satellite: equal configs share ONE jitted prefill/step pair
+    (no re-trace per gauntlet/eval construction); different configs don't."""
+    from photon_tpu.models.decode import decode_jit_pair
+
+    a = decode_jit_pair(_mpt_cfg(alibi=False).model)
+    b = decode_jit_pair(_mpt_cfg(alibi=False).model)  # fresh but equal config
+    assert a[0] is b[0] and a[1] is b[1]
+    c = decode_jit_pair(_mpt_cfg(alibi=True).model)
+    assert c[0] is not a[0]
+
+
 def test_cached_generate_matches_full_forward_bf16():
     """The production compute dtype: bf16 end to end, cached == full."""
     from photon_tpu.eval.icl import make_generate_fn

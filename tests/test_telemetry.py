@@ -460,8 +460,20 @@ def test_registry_constants_are_unique():
 
     names = [v for k, v in vars(profiling).items()
              if isinstance(v, str) and not k.startswith("_")
-             and (v.startswith("server/") or v.startswith("client/"))]
+             and (v.startswith("server/") or v.startswith("client/")
+                  or v.startswith("serve/"))]
     assert len(names) == len(set(names)), "duplicate KPI constants"
+
+
+def test_registry_covers_serve_names():
+    """The serving plane's KPI vocabulary (ISSUE 5 satellite) is declared
+    in the same registry as the training plane's."""
+    from photon_tpu.utils.profiling import registered_metric_names
+
+    names = registered_metric_names()
+    for expect in ("serve/ttft_s", "serve/tokens_per_s", "serve/queue_depth",
+                   "serve/slot_occupancy", "serve/evictions", "serve/rejected"):
+        assert expect in names, expect
 
 
 def test_telemetry_disabled_run_writes_nothing(tmp_path):
