@@ -1,7 +1,7 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test test-all bench bench-host bench-telemetry chaos telemetry-smoke serve-smoke native clean
+.PHONY: test test-all bench bench-host bench-telemetry chaos telemetry-smoke serve-smoke lint lint-tests native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
@@ -35,13 +35,30 @@ telemetry-smoke:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_telemetry.py -q -m "slow or not slow"
 
+# photon-lint (ISSUE 6): the AST rule engine over the repo's invariants —
+# registry-constant KPI/span/event names, None-guarded hook sites, no
+# retrace hazards in jit'd code, scoped locks/owned threads, transport
+# discipline. Fails on any unsuppressed finding (suppress inline with
+# `# photon-lint: ignore[rule]`, or justify in analysis/baseline.json).
+lint:
+	PALLAS_AXON_POOL_IPS= python -m photon_tpu.analysis photon_tpu/
+
+# the lint-marked pytest suite: seeded-violation fixtures per rule family,
+# clean-tree gate, and the dynamic lock-order + retrace detectors. Rides
+# tier-1 too (none of it is slow).
+lint-tests:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_analysis.py -q
+
 # serving smoke (ISSUE 5): the whole serving-plane suite — paged-cache
 # bit-parity with the contiguous decoder, scheduler invariants, HTTP
 # round-trips (blocking + chunked streaming) against a real round
 # checkpoint — then the serving bench, whose exit code asserts continuous
 # batching beats the batch-synchronous baseline on tokens/s at 16
 # concurrent ragged requests. All of it rides tier-1 too (none is slow).
-serve-smoke:
+# photon-lint preflight first: a rule regression (or a fresh violation in
+# serve/) fails the smoke before any engine compile burns minutes
+serve-smoke: lint
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_serve.py -q -m "slow or not slow"
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --serving
@@ -51,7 +68,7 @@ serve-smoke:
 # ChaosConfig(seed=1234) and the injector streams are pure functions of
 # (seed, node_id). Scoped to the files carrying chaos-marked tests so
 # unrelated collection state can't mask a red suite.
-chaos:
+chaos: lint
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_chaos.py tests/test_membership.py tests/test_tcp_driver.py \
 		tests/test_checkpoint.py tests/test_shm.py -q -m chaos

@@ -1,0 +1,48 @@
+"""photon-lint: machine-checked enforcement of the repo's hard-won invariants.
+
+PRs 1-5 accumulated cross-cutting conventions that keep photon-tpu fast and
+correct at scale — KPI/span names come from the ``utils/profiling.py``
+registry, telemetry/chaos hook sites are one ``None`` check when disabled,
+the serving engine never retraces on admission, locks are scoped and
+threads have joining owners, and raw pickle/socket reads live only behind
+the CRC32-framed ``SocketConn`` path. Until now all of them were enforced
+by code review plus a handful of runtime tests; the pjit/TPUv4 scaling
+argument (PAPERS.md) is exactly why that is not enough — pjit-scale
+throughput only holds if nothing silently retraces or syncs to host, the
+class of bug static analysis catches before a benchmark does.
+
+Two halves, stdlib-only (``ast`` — the repo's no-new-deps discipline):
+
+- the **static engine** (:mod:`core` + :mod:`rules`): a rule registry with
+  five repo-specific rule families (``kpi-registry``, ``hook-gating``,
+  ``retrace-hazard``, ``concurrency``, ``transport-discipline``), per-line
+  suppression via ``# photon-lint: ignore[rule-id]`` comments, a checked-in
+  baseline file for deliberate findings, and a CLI
+  (``python -m photon_tpu.analysis`` / ``make lint``);
+- the **dynamic detectors** (:mod:`runtime`): an off-by-default lock-order
+  recorder (patches ``threading.Lock``/``RLock`` under a test fixture,
+  builds the per-thread acquisition graph, fails on cycles) and a retrace
+  sentinel (counts backend compiles via jax monitoring events and fails if
+  a steady-state iteration compiles), both gated by the same one-None-check
+  discipline as ``photon_tpu.chaos`` / ``photon_tpu.telemetry``.
+
+Heavy imports stay out of this module: ``runtime`` must be importable from
+hot-path hook sites without dragging the ast engine in, and the engine
+never imports the modules it scans.
+"""
+
+from __future__ import annotations
+
+__all__ = ["analyze_paths", "main"]
+
+
+def analyze_paths(*args, **kwargs):
+    from photon_tpu.analysis.core import analyze_paths as _impl
+
+    return _impl(*args, **kwargs)
+
+
+def main(argv=None) -> int:
+    from photon_tpu.analysis.cli import main as _impl
+
+    return _impl(argv)

@@ -1,0 +1,7 @@
+"""``python -m photon_tpu.analysis`` — the photon-lint entry point."""
+
+import sys
+
+from photon_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -20,6 +20,7 @@ import zlib
 from typing import Callable
 
 from photon_tpu import telemetry
+from photon_tpu.utils.profiling import CHAOS_EVENT_PREFIX
 
 # resolved lazily to avoid a config<->chaos import cycle: config/schema.py
 # validates ChaosConfig fields, chaos only reads them
@@ -80,7 +81,7 @@ class FaultInjector:
         hit). The emit is a None check when telemetry is off — the chaos
         plane must not tax itself."""
         self.counts[kind] += 1
-        telemetry.emit_event(f"chaos/{kind}", scope=self.scope, **attrs)
+        telemetry.emit_event(CHAOS_EVENT_PREFIX + kind, scope=self.scope, **attrs)
 
     # -- TCP control plane ----------------------------------------------
     def tcp_plan(self) -> TcpFaultPlan:

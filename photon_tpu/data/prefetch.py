@@ -32,7 +32,9 @@ class PrefetchIterator:
         self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
         self._err: BaseException | None = None
         self._stopped = False
-        self._thread = threading.Thread(target=self._fill, daemon=True)
+        self._thread = threading.Thread(
+            target=self._fill, name="photon-prefetch", daemon=True
+        )
         self._thread.start()
 
     def _put(self, item) -> bool:

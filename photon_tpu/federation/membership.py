@@ -30,6 +30,7 @@ from typing import Callable, Iterable
 
 from photon_tpu import telemetry
 from photon_tpu.federation.messages import Ack, Query
+from photon_tpu.utils.profiling import EVENT_MEMBERSHIP_TRANSITION
 
 LIVE = "live"
 SUSPECT = "suspect"
@@ -42,7 +43,7 @@ def _transition_event(nid: str, old: str, new: str, **attrs) -> None:
     JSONL event log with trace correlation to the round span that observed
     it. A None check when telemetry is off."""
     telemetry.emit_event(
-        "membership/transition", node=nid, **{"from": old, "to": new}, **attrs
+        EVENT_MEMBERSHIP_TRANSITION, node=nid, **{"from": old, "to": new}, **attrs
     )
 
 

@@ -127,7 +127,6 @@ class NodeAgent:
         monotonic counters): a chaos-duplicated / network-repeated FitIns
         must not run the fit twice — the second run would double-advance
         per-cid loader/optimizer state and silently skip training data."""
-        import pickle
         from collections import deque
 
         recent: deque[int] = deque(maxlen=256)
@@ -135,9 +134,9 @@ class NodeAgent:
         while True:
             try:
                 env: Envelope = conn.recv()
-            except (EOFError, pickle.UnpicklingError):
-                # an unpicklable frame (CRC-colliding corruption, protocol
-                # mismatch) is a broken stream like any EOF: hand control
+            except EOFError:
+                # a corrupt or unpicklable frame arrives as CorruptFrameError
+                # (an EOFError): a broken stream like any EOF — hand control
                 # back so the supervisor redials instead of dying for good
                 return False
             if env.msg_id in recent_set:

@@ -30,6 +30,7 @@ from typing import Callable, Iterator
 import numpy as np
 
 from photon_tpu import chaos, telemetry
+from photon_tpu.analysis.runtime import steady_point
 from photon_tpu.checkpoint.server import ServerCheckpointManager
 from photon_tpu.codec import ParamsMetadata
 from photon_tpu.config.schema import Config
@@ -708,6 +709,10 @@ class ServerApp:
             # fit/eval span — parents under it in the merged timeline
             with telemetry.span(ROUND_SPAN, round=rnd):
                 self._one_round(cfg, rnd)
+            # retrace-sentinel hook (analysis/runtime.py): a None check
+            # when disabled; under the e2e fixture a steady-state round
+            # that recompiles is billed to its round boundary
+            steady_point("server/round")
 
     def _one_round(self, cfg: Config, rnd: int) -> None:
         if cfg.photon.refresh_period and rnd > 1 and (rnd - 1) % cfg.photon.refresh_period == 0:

@@ -42,7 +42,12 @@ from photon_tpu import chaos, telemetry
 from photon_tpu.federation.driver import Driver
 from photon_tpu.federation.membership import ReconnectPolicy
 from photon_tpu.federation.messages import Ack, Envelope, Query
-from photon_tpu.utils.profiling import TCP_RECV_SPAN, TCP_SEND_SPAN
+from photon_tpu.utils.profiling import (
+    EVENT_TCP_CORRUPT_FRAME,
+    EVENT_TCP_RECONNECT,
+    TCP_RECV_SPAN,
+    TCP_SEND_SPAN,
+)
 
 # frame header: payload length + CRC32 of the payload. The checksum exists
 # for the chaos corruption injector and for real bit-rot alike: a corrupt
@@ -50,6 +55,10 @@ from photon_tpu.utils.profiling import TCP_RECV_SPAN, TCP_SEND_SPAN
 # after it), never as a silently unpickled wrong object.
 _FRAME = struct.Struct("<QI")
 HELLO_KIND = "__hello__"
+#: bound on the accept loop's HELLO read: a connected-but-silent peer is
+#: dropped (it redials) instead of monopolizing accepts or pinning
+#: shutdown's accept-thread join
+_HELLO_TIMEOUT_S = 2.0
 
 
 class CorruptFrameError(EOFError):
@@ -65,6 +74,10 @@ class SocketConn:
 
     def __init__(self, sock: socket.socket) -> None:
         self.sock = sock
+        #: absolute time.monotonic() bound on a whole recv() (header AND
+        #: payload). A plain settimeout resets per sock.recv, so a slow-drip
+        #: peer (1 byte per timeout) never trips it; the deadline shrinks.
+        self.deadline: float | None = None
         try:
             self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
@@ -103,6 +116,11 @@ class SocketConn:
     def _read_exact(self, n: int) -> bytes:
         buf = bytearray()
         while len(buf) < n:
+            if self.deadline is not None:
+                remaining = self.deadline - time.monotonic()
+                if remaining <= 0:
+                    raise socket.timeout("recv deadline exceeded")
+                self.sock.settimeout(remaining)
             chunk = self.sock.recv(n - len(buf))
             if not chunk:
                 raise EOFError("peer closed")
@@ -120,9 +138,17 @@ class SocketConn:
         if zlib.crc32(data) != crc:
             # the teardown this forces is a structured event: correlate the
             # connection loss with whatever round span was active
-            telemetry.emit_event("tcp/corrupt_frame", nbytes=n)
+            telemetry.emit_event(EVENT_TCP_CORRUPT_FRAME, nbytes=n)
             raise CorruptFrameError(f"frame CRC mismatch ({n} bytes)")
-        return pickle.loads(data)
+        try:
+            return pickle.loads(data)
+        except Exception as exc:
+            # CRC-valid but undecodable: a version-skewed peer (renamed
+            # class/module) raises ModuleNotFoundError/AttributeError, not
+            # UnpicklingError. The stream can't be trusted any more than a
+            # corrupt one — same remedy, tear the connection down.
+            telemetry.emit_event(EVENT_TCP_CORRUPT_FRAME, nbytes=n)
+            raise CorruptFrameError(f"frame unpicklable ({n} bytes): {exc!r}") from exc
 
     def close(self) -> None:
         try:
@@ -148,7 +174,9 @@ class TcpServerDriver(Driver):
         self._mid = iter(range(1 << 62))
         self._listener = socket.create_server((host, port))
         self._accepting = True
-        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="photon-tcp-accept", daemon=True
+        )
         self._accept_thread.start()
 
     @property
@@ -162,14 +190,30 @@ class TcpServerDriver(Driver):
             except OSError:
                 return
             conn = SocketConn(sock)
+            # the HELLO read is deadline-bounded: an accepted-but-silent or
+            # byte-dripping peer (wedged node, SYN-scan, delayed frame) must
+            # neither monopolize the accept loop nor pin shutdown's join. 2s
+            # is 40x the default chaos tcp_delay_max_s; socket.timeout is an
+            # OSError, so a too-slow peer just gets dropped and redials.
+            conn.deadline = time.monotonic() + _HELLO_TIMEOUT_S
             try:
                 hello = conn.recv()
-            except (EOFError, OSError, pickle.UnpicklingError):
+            except (EOFError, OSError):  # incl. CorruptFrameError/timeout
                 conn.close()
                 continue
-            if not (isinstance(hello, dict) and hello.get("kind") == HELLO_KIND):
+            # full validation BEFORE any keyed access: a version-skewed or
+            # buggy client's HELLO must drop one connection, never KeyError
+            # the accept thread to death (the server would silently stop
+            # registering reconnections forever)
+            if not (
+                isinstance(hello, dict)
+                and hello.get("kind") == HELLO_KIND
+                and hello.get("node_id") is not None
+            ):
                 conn.close()
                 continue
+            conn.deadline = None
+            sock.settimeout(None)  # registered conns block under the selector
             node_id = str(hello["node_id"])
             with self._lock:
                 old = self._nodes.get(node_id)
@@ -188,10 +232,12 @@ class TcpServerDriver(Driver):
                          Ack(ok=False, detail="node died: reconnected mid-request",
                              node_id=node_id))
                     )
-                self._hello_stats[node_id] = {
-                    "reconnects": int(hello.get("reconnects", 0)),
-                    "backoff_s": float(hello.get("backoff_s", 0.0)),
-                }
+                try:
+                    rc = int(hello.get("reconnects", 0))
+                    bo = float(hello.get("backoff_s", 0.0))
+                except (TypeError, ValueError):
+                    rc, bo = 0, 0.0  # skewed client: bad stats, fine node
+                self._hello_stats[node_id] = {"reconnects": rc, "backoff_s": bo}
             if old is not None:
                 old.close()  # reconnection replaces the stale socket
 
@@ -272,9 +318,10 @@ class TcpServerDriver(Driver):
                 nid, conn = ready[0][0].data
                 try:
                     env: Envelope = conn.recv()
-                except (EOFError, OSError, pickle.UnpicklingError):
-                    # (CorruptFrameError lands here too, via EOFError: once a
-                    # frame fails its CRC the stream offset is untrusted and
+                except (EOFError, OSError):
+                    # (CorruptFrameError lands here too, via EOFError — CRC
+                    # failure or unpicklable payload alike: once a frame
+                    # can't be trusted the stream offset is untrusted and
                     # the connection must die)
                     with self._lock:
                         if self._nodes.get(nid) is conn:
@@ -315,6 +362,10 @@ class TcpServerDriver(Driver):
             self._listener.close()
         except OSError:
             pass
+        # closing the listener EBADFs the blocking accept() and the bounded
+        # HELLO read wakes within _HELLO_TIMEOUT_S, so the join is prompt
+        # (thread-ownership audit: every thread has an owner that joins it)
+        self._accept_thread.join(timeout=_HELLO_TIMEOUT_S + 3)
         with self._lock:
             nodes = list(self._nodes.items())
         for nid, conn in nodes:
@@ -327,9 +378,12 @@ class TcpServerDriver(Driver):
         # treat clean shutdown as a server crash and redial for minutes
         for nid, conn in nodes:
             try:
-                conn.sock.settimeout(ack_timeout)
+                # absolute deadline, not settimeout: a byte-dripping node
+                # would reset a per-recv timeout forever (same hole the
+                # HELLO read closes) and pin shutdown past ack_timeout
+                conn.deadline = time.monotonic() + ack_timeout
                 conn.recv()
-            except (OSError, EOFError, pickle.UnpicklingError):
+            except (OSError, EOFError):
                 pass
             conn.close()
         with self._lock:
@@ -447,7 +501,7 @@ def run_node(
         # buffered node-side event; rides the next fit/eval result back to
         # the server's JSONL log
         telemetry.emit_event(
-            "tcp/reconnect", node=node_id, reconnects=reconnects,
+            EVENT_TCP_RECONNECT, node=node_id, reconnects=reconnects,
             backoff_s=d, backoff_total_s=backoff_total,
         )
         sleep(d)

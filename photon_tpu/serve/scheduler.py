@@ -36,6 +36,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 from photon_tpu import telemetry
+from photon_tpu.analysis.runtime import steady_point
 from photon_tpu.metrics.history import History
 from photon_tpu.serve.engine import PagedEngine
 from photon_tpu.utils.profiling import (
@@ -215,6 +216,11 @@ class ContinuousBatcher:
             except Exception as e:  # noqa: BLE001 — fail loudly, not silently
                 self._fail_all(f"{type(e).__name__}: {e}")
             self._record_tick()
+            # retrace-sentinel hook (analysis/runtime.py): one None check
+            # when no sentinel is installed; under the e2e fixture it bills
+            # any steady-state compile to the tick that caused it — the
+            # machine-checked form of "admission never retraces"
+            steady_point("serve/tick")
         self._drain_on_stop()
 
     def _admit_phase(self) -> None:

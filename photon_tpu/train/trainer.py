@@ -44,6 +44,7 @@ from photon_tpu.utils.profiling import (
     CLIENT_LR,
     CLIENT_STEPS,
     CLIENT_TOKENS_PER_SEC,
+    EVENT_SPEED_MONITOR_PEAK,
     SpeedMonitor,
 )
 
@@ -209,7 +210,7 @@ class Trainer:
             device_kind=getattr(mesh_devices.flat[0], "device_kind", ""),
         )
         telemetry.emit_event(
-            "speed_monitor/peak",
+            EVENT_SPEED_MONITOR_PEAK,
             device_kind=self.speed_monitor.device_kind,
             peak_flops_per_chip=self.speed_monitor.peak_flops_per_chip,
             n_chips=self.speed_monitor.n_chips,

@@ -31,9 +31,13 @@ def heartbeat(label: str, every_s: float = 60.0, *,
             print(f"{label}… {time.perf_counter() - t0:.0f}s",
                   file=out, flush=True)
 
-    t = threading.Thread(target=_tick, daemon=True)
+    t = threading.Thread(target=_tick, name="photon-heartbeat", daemon=True)
     t.start()
     try:
         yield
     finally:
         done.set()
+        # the ticker wakes from done.wait() immediately; joining makes the
+        # context manager the thread's owner (no orphaned ticker can print
+        # over a later phase's output)
+        t.join(timeout=5)

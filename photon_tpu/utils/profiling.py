@@ -116,6 +116,23 @@ SERVE_QUEUE_SPAN = "serve/queue"
 SERVE_PREFILL_SPAN = "serve/prefill"
 SERVE_DECODE_SPAN = "serve/decode"
 
+# -- structured event kinds (telemetry/events.py JSONL log) ---------------
+# Event names are registry constants for the same reason KPI/span names
+# are: photon-lint's kpi-registry rule flags any string literal at an
+# emit_event site, so a typo'd event kind can't silently fork the
+# vocabulary consumers (export.py, dashboards) query by.
+#: every LivenessTracker state-machine edge, incl. first registration
+EVENT_MEMBERSHIP_TRANSITION = "membership/transition"
+#: node agent redialed the server (supervisor loop, federation/tcp.py)
+EVENT_TCP_RECONNECT = "tcp/reconnect"
+#: CRC32 frame-check failure tore a connection down
+EVENT_TCP_CORRUPT_FRAME = "tcp/corrupt_frame"
+#: SpeedMonitor resolved its bf16 peak (device_kind + basis for MFU)
+EVENT_SPEED_MONITOR_PEAK = "speed_monitor/peak"
+#: fault-injector firings are ``chaos/<plan kind>`` (chaos/injector.py
+#: counters: tcp_drop, store_bitflip, crash, ...)
+CHAOS_EVENT_PREFIX = "chaos/"
+
 #: dynamic metric-name families the registry can't enumerate statically:
 #: per-strategy-state norms (``server/{state_key}_norm``,
 #: strategy/base.py:norm_telemetry). Patterns are re.fullmatch'd.

@@ -1,0 +1,767 @@
+"""photon-lint suite (ISSUE 6): the static rule engine + dynamic detectors.
+
+Contract per rule family: (a) a seeded-violation fixture MUST be flagged,
+(b) the idiomatic spelling of the same code MUST pass, and (c) the current
+photon_tpu tree MUST be clean (zero unsuppressed findings against the
+checked-in baseline) — so a rule regression, a new violation, or baseline
+rot each fail a different, named test.
+
+The dynamic half: a deliberate lock-order inversion must be caught, a
+consistent order must not; a steady-state retrace must be caught, a cache
+hit must not; and — telemetry's hook-site discipline — both detectors must
+be one ``None`` check when not installed.
+"""
+
+import json
+import pathlib
+import textwrap
+import threading
+
+import pytest
+
+import photon_tpu
+from photon_tpu.analysis import runtime as rt
+from photon_tpu.analysis.cli import DEFAULT_BASELINE, main as lint_main
+from photon_tpu.analysis.core import (
+    NameRegistry,
+    analyze_paths,
+    load_baseline,
+    write_baseline,
+)
+
+pytestmark = pytest.mark.lint
+
+PKG = pathlib.Path(photon_tpu.__file__).resolve().parent
+
+
+def _lint(tmp_path, src, select=None, name="snippet.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(src))
+    return analyze_paths([str(f)], baseline=None, select=select).unsuppressed
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# rule family 1: kpi-registry
+# ---------------------------------------------------------------------------
+
+
+def test_kpi_registry_flags_literals(tmp_path):
+    found = _lint(
+        tmp_path,
+        """
+        from photon_tpu import telemetry
+
+        def record_sites(history, tracer):
+            history.record(1, {"server/round_time": 1.0})       # stringly
+            history.record(1, {"server/definitely_a_typo": 1})  # unknown
+            tracer.add_span("client/fit_time", 0.0, 1.0)        # stringly
+            telemetry.emit_event(f"chaos/{1}")                  # f-string
+        """,
+        select=["kpi-registry"],
+    )
+    assert _rules(found) == {
+        "kpi-registry/stringly-name",
+        "kpi-registry/unregistered-name",
+        "kpi-registry/fstring-name",
+    }
+    assert len(found) == 4
+
+
+def test_kpi_registry_constants_pass(tmp_path):
+    found = _lint(
+        tmp_path,
+        """
+        from photon_tpu import telemetry
+        from photon_tpu.utils.profiling import CHAOS_EVENT_PREFIX, ROUND_TIME
+
+        def record_sites(history, tracer, kind, metrics):
+            history.record(1, {ROUND_TIME: 1.0})
+            history.record(1, metrics)             # dynamic dict: not static
+            tracer.add_span(ROUND_TIME, 0.0, 1.0)
+            telemetry.emit_event(CHAOS_EVENT_PREFIX + kind)
+        """,
+        select=["kpi-registry"],
+    )
+    assert found == []
+
+
+def test_registry_parse_matches_runtime_registry():
+    """The statically parsed constants agree with the live module — the
+    lint and the runtime registry test can never drift apart."""
+    from photon_tpu.utils import profiling
+
+    reg = NameRegistry.parse(PKG / "utils" / "profiling.py")
+    assert set(profiling.registered_metric_names()) <= set(reg.values)
+    assert reg.dynamic_patterns == profiling.DYNAMIC_METRIC_PATTERNS
+    assert reg.is_registered("server/round_time")
+    assert reg.is_registered("server/anything_norm")  # dynamic family
+    assert not reg.is_registered("server/not_a_metric")
+
+
+# ---------------------------------------------------------------------------
+# rule family 2: hook-gating
+# ---------------------------------------------------------------------------
+
+
+def test_hook_gating_flags_unguarded_and_chained(tmp_path):
+    found = _lint(
+        tmp_path,
+        """
+        from photon_tpu import chaos, telemetry
+
+        def unguarded():
+            tr = telemetry.active()
+            tr.drain()
+
+        def chained():
+            return chaos.active().tcp_plan()
+
+        def guard_too_late():
+            tr2 = telemetry.active()
+            tr2.drain()  # crashes when disabled: the guard below can't help
+            if tr2 is not None:
+                tr2.flush()
+
+        def guard_falls_through():
+            tr3 = telemetry.active()
+            if tr3 is None:
+                print("disabled")  # no return: tr3 is STILL None below
+            tr3.drain()
+
+        def or_is_not_a_guard(fallback):
+            tr4 = telemetry.active()
+            x = tr4 or fallback
+            tr4.drain()
+        """,
+        select=["hook-gating"],
+    )
+    assert _rules(found) == {"hook-gating/unguarded", "hook-gating/chained-active"}
+    assert sum(f.rule == "hook-gating/unguarded" for f in found) == 4
+
+
+def test_hook_gating_guarded_passes(tmp_path):
+    found = _lint(
+        tmp_path,
+        """
+        from photon_tpu import telemetry
+
+        def early_return():
+            tr = telemetry.active()
+            if tr is None:
+                return
+            tr.drain()
+
+        def closure_guard():
+            tracer = telemetry.active()
+            def worker():
+                if tracer is not None:
+                    tracer.drain()
+            return worker
+
+        def truthiness():
+            log = telemetry.events_active()
+            if log:
+                log.drain()
+
+        def compound_or_early_return():
+            tr = telemetry.active()
+            if tr is None or not tr.piggyback:
+                return
+            tr.drain()
+
+        def and_shortcircuit():
+            tr = telemetry.active()
+            return tr and tr.drain()
+        """,
+        select=["hook-gating"],
+    )
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# rule family 3: retrace-hazard
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_hazard_flags_syncs_branches_mutation(tmp_path):
+    found = _lint(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x, y):
+            if x > 0:                      # traced branch
+                y = float(x)               # scalar cast
+            z = np.asarray(y)              # numpy materialization
+            return z.item()                # host sync
+
+        class Engine:
+            @jax.jit
+            def step(self, tokens):
+                self.cache = tokens        # self mutation under trace
+                return tokens
+        """,
+        select=["retrace-hazard"],
+    )
+    assert _rules(found) == {
+        "retrace-hazard/traced-branch",
+        "retrace-hazard/host-sync",
+        "retrace-hazard/self-mutation",
+    }
+    assert sum(f.rule.endswith("host-sync") for f in found) == 3
+
+
+def test_retrace_hazard_static_and_shape_uses_pass(tmp_path):
+    found = _lint(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n",))
+        def bucketed(x, n):
+            if n > 8:                       # static arg: fine
+                x = x[:n]
+            if x.shape[0] > 4:              # shape read: static under trace
+                x = x * 2
+            if x is None:                   # None check: static
+                return jnp.zeros(())
+            return int(x.shape[0]) + x.sum()
+
+        def wrapped(state, batch):
+            return state + batch.sum()
+
+        step = jax.jit(wrapped, donate_argnums=(0,))
+        """,
+        select=["retrace-hazard"],
+    )
+    assert found == []
+
+
+def test_retrace_hazard_sees_jit_wrapping_call(tmp_path):
+    found = _lint(
+        tmp_path,
+        """
+        import jax
+
+        def step_fn(state, tok):
+            return state, float(tok)
+
+        _step = jax.jit(step_fn)
+        """,
+        select=["retrace-hazard"],
+    )
+    assert _rules(found) == {"retrace-hazard/host-sync"}
+
+
+# ---------------------------------------------------------------------------
+# rule family 4: concurrency
+# ---------------------------------------------------------------------------
+
+
+def test_concurrency_fixture(tmp_path):
+    found = _lint(
+        tmp_path,
+        """
+        import os
+        import threading
+
+        def bare(lock):
+            lock.acquire()
+            lock.release()
+
+        def fire_and_forget():
+            threading.Thread(target=print).start()
+
+        def swallow():
+            try:
+                pass
+            except:
+                pass
+            try:
+                pass
+            except Exception:
+                pass
+            os._exit(3)
+        """,
+        select=["concurrency"],
+    )
+    assert _rules(found) == {
+        "concurrency/bare-acquire",
+        "concurrency/unnamed-thread",
+        "concurrency/unowned-thread",
+        "concurrency/swallowed-exception",
+        "concurrency/os-exit",
+    }
+
+
+def test_concurrency_idiomatic_passes(tmp_path):
+    found = _lint(
+        tmp_path,
+        """
+        import threading
+
+        def scoped(lock):
+            with lock:
+                pass
+
+        def try_finally(lock):
+            lock.acquire(timeout=1)
+            try:
+                pass
+            finally:
+                lock.release()
+
+        class Owner:
+            def start(self):
+                self._thread = threading.Thread(
+                    target=print, name="owned", daemon=True
+                )
+                self._thread.start()
+
+            def close(self):
+                self._thread.join(timeout=5)
+
+        def narrow():
+            try:
+                pass
+            except OSError:
+                pass  # typed-narrow swallow is allowed
+        """,
+        select=["concurrency"],
+    )
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# rule family 5: transport-discipline
+# ---------------------------------------------------------------------------
+
+
+def test_transport_discipline_fixture(tmp_path):
+    found = _lint(
+        tmp_path,
+        """
+        import pickle
+
+        def raw_wire(sock):
+            data = sock.recv(4096)
+            return pickle.loads(data)
+        """,
+        select=["transport-discipline"],
+    )
+    assert _rules(found) == {
+        "transport-discipline/raw-pickle",
+        "transport-discipline/raw-socket-read",
+    }
+
+
+def test_transport_discipline_framed_conn_passes(tmp_path):
+    found = _lint(
+        tmp_path,
+        """
+        def framed(conn):
+            return conn.recv()  # SocketConn/Connection: the framed path
+        """,
+        select=["transport-discipline"],
+    )
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression_same_and_next_line(tmp_path):
+    found = _lint(
+        tmp_path,
+        """
+        import os
+
+        def a():
+            os._exit(1)  # photon-lint: ignore[concurrency/os-exit]
+
+        def b():
+            # photon-lint: ignore[concurrency]
+            os._exit(2)
+
+        def c():
+            os._exit(3)  # no suppression: still flagged
+        """,
+        select=["concurrency"],
+    )
+    assert len(found) == 1 and found[0].rule == "concurrency/os-exit"
+    assert found[0].snippet.startswith("os._exit(3)")
+
+
+def test_baseline_roundtrip_and_staleness(tmp_path):
+    bad = tmp_path / "mod.py"
+    bad.write_text("import os\nos._exit(1)\n")
+    base = tmp_path / "baseline.json"
+    rep = analyze_paths([str(bad)], baseline=None)
+    assert len(rep.unsuppressed) == 1
+    write_baseline(base, rep.unsuppressed)
+    entries = load_baseline(base)
+    assert len(entries) == 1 and entries[0].rule == "concurrency/os-exit"
+
+    rep2 = analyze_paths([str(bad)], baseline=base)
+    assert rep2.ok and not rep2.stale_baseline
+    assert sum(1 for f in rep2.findings if f.baselined) == 1
+
+    # the offending line changes -> the entry is STALE and the (new)
+    # finding is unsuppressed again: baselines can't mask fresh violations
+    bad.write_text("import os\nos._exit(2)\n")
+    rep3 = analyze_paths([str(bad)], baseline=base)
+    assert not rep3.ok
+    assert [e.fingerprint for e in rep3.stale_baseline] == [entries[0].fingerprint]
+
+
+def test_partial_scan_keeps_unscanned_baseline_entries(tmp_path):
+    """Scanning a subset of the tree must neither report unscanned files'
+    baseline entries as stale nor delete them on --write-baseline."""
+    a = tmp_path / "a.py"
+    a.write_text("import os\nos._exit(1)\n")
+    b = tmp_path / "b.py"
+    b.write_text("import os\nos._exit(2)\n")
+    base = tmp_path / "baseline.json"
+    rep_all = analyze_paths([str(a), str(b)], baseline=None)
+    write_baseline(base, rep_all.unsuppressed, scanned_paths=rep_all.scanned_paths)
+    n_all = len(load_baseline(base))
+    assert n_all == 2
+
+    # partial scan: b.py's entry is invisible, NOT stale
+    rep_a = analyze_paths([str(a)], baseline=base)
+    assert rep_a.ok and not rep_a.stale_baseline
+
+    # partial --write-baseline path: b.py's entry survives the rewrite
+    write_baseline(
+        base,
+        [f for f in rep_a.findings if not f.suppressed],
+        scanned_paths=rep_a.scanned_paths,
+    )
+    assert len(load_baseline(base)) == n_all
+
+    # a genuinely stale entry in a SCANNED file still fails
+    a.write_text("x = 1\n")
+    rep_fixed = analyze_paths([str(a)], baseline=base)
+    assert not rep_fixed.ok and len(rep_fixed.stale_baseline) == 1
+
+
+def test_string_join_is_not_thread_ownership(tmp_path):
+    """A `", ".join(parts)` must not satisfy the unowned-thread rule; a
+    join on a Thread-assigned name or *thread*-named attribute must."""
+    found = _lint(
+        tmp_path,
+        """
+        import threading
+
+        def fire_and_forget(parts):
+            threading.Thread(target=print, name="t").start()
+            return ", ".join(parts)
+        """,
+        select=["concurrency"],
+    )
+    assert _rules(found) == {"concurrency/unowned-thread"}
+
+    found = _lint(
+        tmp_path,
+        """
+        import threading
+
+        def owned():
+            t = threading.Thread(target=print, name="t")
+            t.start()
+            t.join()
+        """,
+        select=["concurrency"],
+    )
+    assert found == []
+
+
+def test_partially_fixed_count_entry_goes_stale(tmp_path):
+    """Fixing ONE of two identical baselined lines must surface the entry
+    as stale — leftover count budget would otherwise silently baseline the
+    NEXT identical violation with no human re-justifying it."""
+    mod = tmp_path / "mod.py"
+    mod.write_text("import os\nos._exit(1)\nos._exit(1)\n")
+    base = tmp_path / "baseline.json"
+    rep = analyze_paths([str(mod)], baseline=None)
+    write_baseline(base, rep.unsuppressed)
+    assert load_baseline(base)[0].count == 2
+    assert analyze_paths([str(mod)], baseline=base).ok
+
+    mod.write_text("import os\nos._exit(1)\n")  # one of the two fixed
+    rep2 = analyze_paths([str(mod)], baseline=base)
+    assert not rep2.ok and len(rep2.stale_baseline) == 1
+
+
+def test_suppression_syntax_in_string_is_inert(tmp_path):
+    """Docs QUOTING the ignore syntax inside a string literal must not
+    suppress anything — only real comment tokens register suppressions.
+    Both stringly spellings that fooled the line-regex scanner: a string
+    ending on the comment-shaped line (next-line form) and a string on the
+    violating line itself (same-line form)."""
+    found = _lint(
+        tmp_path,
+        '''
+        import os
+
+        DOC = """
+        # photon-lint: ignore[concurrency]"""
+        os._exit(1)
+
+        s = "# photon-lint: ignore[concurrency]"; os._exit(2)
+        ''',
+        select=["concurrency"],
+    )
+    assert _rules(found) == {"concurrency/os-exit"} and len(found) == 2
+
+
+def test_select_scan_keeps_unselected_baseline_entries(tmp_path):
+    """A --select run can only judge entries of the selected families: it
+    must neither report other families' entries as stale nor delete them
+    on --write-baseline."""
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import os, pickle\n"
+        "def f(data):\n"
+        "    os._exit(1)\n"
+        "    return pickle.loads(data)\n"
+    )
+    base = tmp_path / "baseline.json"
+    rep = analyze_paths([str(mod)], baseline=None)
+    write_baseline(base, rep.unsuppressed)
+    assert {e.rule.split("/", 1)[0] for e in load_baseline(base)} == {
+        "concurrency", "transport-discipline",
+    }
+
+    rep_sel = analyze_paths([str(mod)], baseline=base, select=["concurrency"])
+    assert rep_sel.ok and not rep_sel.stale_baseline
+
+    write_baseline(
+        base,
+        [f for f in rep_sel.findings if not f.suppressed],
+        scanned_paths=rep_sel.scanned_paths,
+        selected_families=frozenset(["concurrency"]),
+    )
+    assert {e.rule.split("/", 1)[0] for e in load_baseline(base)} == {
+        "concurrency", "transport-discipline",
+    }
+
+
+def test_overlapping_paths_scan_each_file_once(tmp_path):
+    """dir + file-inside-dir must not double-scan: duplicate findings blow
+    the baseline's per-fingerprint count budget (FAIL on a clean tree) and
+    inflate counts on --write-baseline."""
+    mod = tmp_path / "mod.py"
+    mod.write_text("import os\nos._exit(1)\n")
+    base = tmp_path / "baseline.json"
+    rep = analyze_paths([str(mod)], baseline=None)
+    write_baseline(base, rep.unsuppressed)
+
+    rep2 = analyze_paths([str(tmp_path), str(mod)], baseline=base)
+    assert rep2.n_files == 1
+    assert rep2.ok, [f.format() for f in rep2.unsuppressed]
+    assert load_baseline(base)[0].count == 1
+
+
+def test_cli_missing_or_empty_paths_are_usage_errors(tmp_path):
+    assert lint_main([str(tmp_path / "no_such_dir")]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert lint_main([str(empty), "--no-baseline"]) == 2
+
+
+def test_checked_in_baseline_is_justified():
+    entries = load_baseline(DEFAULT_BASELINE)
+    assert entries, "baseline file missing"
+    for e in entries:
+        assert e.justification and "TODO" not in e.justification, e.path
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nos._exit(1)\n")
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert lint_main([str(bad), "--no-baseline"]) == 1
+    assert lint_main([str(good), "--no-baseline"]) == 0
+    assert lint_main(["--list-rules"]) == 0
+    assert lint_main([str(bad), "--no-baseline", "--json"]) == 1
+    out = capsys.readouterr().out
+    payload = json.loads(out[out.index("{"):])
+    assert payload["findings"][0]["rule"] == "concurrency/os-exit"
+
+
+def test_current_tree_is_clean():
+    """THE acceptance gate: zero unsuppressed findings on photon_tpu/
+    against the checked-in baseline, and no stale baseline entries."""
+    rep = analyze_paths([str(PKG)], baseline=DEFAULT_BASELINE)
+    assert rep.unsuppressed == [], "\n".join(f.format() for f in rep.unsuppressed)
+    assert rep.stale_baseline == [], [e.path for e in rep.stale_baseline]
+    assert rep.n_files > 100  # the walk actually covered the tree
+
+
+# ---------------------------------------------------------------------------
+# dynamic: lock-order recorder
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _clean_detectors():
+    yield
+    rt.uninstall_lock_order()
+    rt.uninstall_retrace_sentinel()
+
+
+def test_lock_order_inversion_detected():
+    rec = rt.install_lock_order()
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def ab():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def ba():
+        with lock_b:
+            with lock_a:
+                pass
+
+    for fn in (ab, ba):
+        t = threading.Thread(target=fn, name="inv", daemon=True)
+        t.start()
+        t.join()
+    with pytest.raises(rt.LockOrderViolation, match="inversion"):
+        rec.check()
+
+
+def test_lock_order_consistent_is_green():
+    with rt.lock_order_guard() as rec:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        for _ in range(3):
+            with lock_a:
+                with lock_b:
+                    pass
+    assert rec.n_locks >= 2
+    assert rt.lock_order_active() is None  # guard uninstalled
+
+
+def test_surviving_wrappers_go_quiet_after_uninstall():
+    """Locks created while installed outlive the recorder (their owners
+    keep holding them) — after uninstall they must degrade to a None check,
+    not keep feeding the dead recorder's graph on every acquire."""
+    rec = rt.install_lock_order()
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    with lock_a:
+        pass
+    n_before = rec.n_acquires
+    assert n_before >= 1
+    rt.uninstall_lock_order()
+    with lock_a:  # wrappers still work, recording is off
+        with lock_b:
+            pass
+    assert rec.n_acquires == n_before
+    assert not rec.edges()
+
+
+def test_lock_order_tracks_condition_protocol():
+    """Condition on tracked Lock AND tracked RLock (the ContinuousBatcher
+    shape): wait/notify must round-trip through the wrappers."""
+    rec = rt.install_lock_order()
+    cond_default = threading.Condition()  # internally RLock()
+    with cond_default:
+        cond_default.notify_all()
+    cond_lock = threading.Condition(threading.Lock())
+    with cond_lock:
+        cond_lock.wait(timeout=0.01)
+    ev = threading.Event()
+    ev.set()
+    assert rec.n_locks >= 2
+    rec.check()  # no inversion
+
+
+# ---------------------------------------------------------------------------
+# dynamic: retrace sentinel
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_sentinel_catches_steady_state_compile():
+    import jax
+    import jax.numpy as jnp
+
+    s = rt.install_retrace_sentinel()
+    f = jax.jit(lambda x: x * 2)
+    f(jnp.ones((4,)))
+    assert s.compiles >= 1  # warmup observed
+    s.mark_steady()
+    f(jnp.ones((4,)))  # cache hit
+    rt.steady_point("tick")
+    assert s.violations == []
+    f(jnp.ones((5,)))  # new shape: retrace (the ones() itself compiles too)
+    rt.steady_point("tick")
+    with pytest.raises(rt.RetraceViolation, match="tick: "):
+        s.check()
+
+
+def test_retrace_sentinel_steady_after_points():
+    import jax
+    import jax.numpy as jnp
+
+    s = rt.install_retrace_sentinel()
+    s.mark_steady_after(2)
+    g = jax.jit(lambda x: x + 1)
+    for i in (3, 4):  # two warmup iterations, each compiles
+        g(jnp.ones((i,)))
+        rt.steady_point("warm")
+    assert s.steady
+    g(jnp.ones((3,)))  # steady cache hit
+    rt.steady_point("steady")
+    s.check()  # green
+
+
+def test_retrace_sentinel_warmup_check_does_not_consume_point_budget():
+    """check() during warmup must be inert: only real steady_point hook
+    sites advance mark_steady_after's budget, so a per-round assertion
+    can't flip steady early and bill legitimate warmup compiles."""
+    import jax
+    import jax.numpy as jnp
+
+    s = rt.install_retrace_sentinel()
+    s.mark_steady_after(2)
+    g = jax.jit(lambda x: x - 1)
+    g(jnp.ones((3,)))
+    rt.steady_point("warm")
+    s.check()  # mid-warmup assertion: must not count as the 2nd point
+    assert not s.steady
+    g(jnp.ones((4,)))  # second warmup compile, still legitimate
+    rt.steady_point("warm")
+    assert s.steady
+    g(jnp.ones((3,)))  # cache hit
+    rt.steady_point("steady")
+    s.check()  # green
+
+
+def test_disabled_detectors_are_none_checks():
+    """Telemetry's hook-site discipline, asserted the same way: with
+    nothing installed the hooks are a single None check and the threading
+    factories are the real C ones."""
+    assert rt.lock_order_active() is None
+    assert rt.retrace_active() is None
+    rt.steady_point("anything")  # must not raise, must not allocate state
+    assert threading.Lock.__module__ == "_thread"
+    rec = rt.install_lock_order()
+    assert threading.Lock == rec._make_lock  # == : bound methods compare by (self, func)
+    rt.uninstall_lock_order()
+    assert threading.Lock.__module__ == "_thread"

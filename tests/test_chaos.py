@@ -366,6 +366,28 @@ def test_chaos_crash_midfit_node_readmitted_e2e(tmp_path):
 
 @pytest.mark.slow
 def test_soak_random_failures_across_rounds(tmp_path):
+    """Sustained randomized failures across 6 rounds — now ALSO under both
+    photon-lint dynamic detectors (ISSUE 6): every lock the server, driver,
+    host pool and agents create is order-tracked (teardown fails on any
+    potential-deadlock cycle), and after a 3-round warmup the retrace
+    sentinel fails the run if a steady-state round compiles anything — the
+    failure/retry/recovery paths must not silently retrace."""
+    from photon_tpu.analysis import runtime as lint_rt
+
+    lock_rec = lint_rt.install_lock_order()
+    sentinel = lint_rt.install_retrace_sentinel()
+    sentinel.mark_steady_after(3)  # server/round hook: rounds 4-6 steady
+    try:
+        _soak_body(tmp_path)
+        assert sentinel.steady, "round hook never fired"
+        sentinel.check()
+        lock_rec.check()
+    finally:
+        lint_rt.uninstall_retrace_sentinel()
+        lint_rt.uninstall_lock_order()
+
+
+def _soak_body(tmp_path):
     n_rounds = 6
     cfg = make_cfg(
         tmp_path,

@@ -127,17 +127,29 @@ def test_block_allocator_guards():
 @pytest.fixture(scope="module")
 def served():
     """One tiny MPT engine + batcher shared by the behavioral tests (module
-    scope: the jit compiles dominate; state fully drains between tests)."""
+    scope: the jit compiles dominate; state fully drains between tests).
+
+    The whole fixture lifetime runs under the photon-lint lock-order
+    recorder (ISSUE 6): every lock the engine/batcher/frontend creates is
+    tracked, and teardown fails on any acquisition-order cycle observed
+    across ALL the behavioral tests — a potential deadlock between the
+    scheduler loop, submitters, and the telemetry plane."""
+    from photon_tpu.analysis import runtime as lint_rt
     from photon_tpu.models.mpt import init_params
     from photon_tpu.serve.engine import PagedEngine
     from photon_tpu.serve.scheduler import ContinuousBatcher
 
-    cfg = _serve_cfg(n_slots=2, block_size=4, max_seq=32, max_new=8)
-    params = init_params(cfg.model, seed=4)
-    engine = PagedEngine(cfg, params)
-    batcher = ContinuousBatcher(engine, max_queue=64).start()
-    yield cfg, params, engine, batcher
-    batcher.close()
+    recorder = lint_rt.install_lock_order()
+    try:
+        cfg = _serve_cfg(n_slots=2, block_size=4, max_seq=32, max_new=8)
+        params = init_params(cfg.model, seed=4)
+        engine = PagedEngine(cfg, params)
+        batcher = ContinuousBatcher(engine, max_queue=64).start()
+        yield cfg, params, engine, batcher
+        batcher.close()
+        recorder.check()  # green = no lock-order inversion anywhere above
+    finally:
+        lint_rt.uninstall_lock_order()
 
 
 def _assert_drained(engine, batcher):
@@ -228,6 +240,35 @@ def test_failed_admission_is_transactional(served):
     _assert_drained(engine, batcher)
     ok = batcher.submit([5, 9, 2], 4).result(timeout=60)  # still serving
     assert ok == _offline_greedy(cfg, params, [5, 9, 2], 4)
+    _assert_drained(engine, batcher)
+
+
+def test_steady_state_serving_never_retraces(served):
+    """ISSUE 6 e2e wiring: with the engine warm (every prefill bucket and
+    the decode step already compiled by the tests above), the photon-lint
+    retrace sentinel rides a fresh burst of ragged traffic — the scheduler
+    loop's ``steady_point("serve/tick")`` hook bills any compile to its
+    tick, and ANY compile fails. This is PR 5's "admission never retraces"
+    contract, machine-checked instead of argued."""
+    from photon_tpu.analysis import runtime as lint_rt
+
+    cfg, params, engine, batcher = served
+    rng = np.random.default_rng(21)
+    prompts = _ragged_prompts(rng, 6, cfg.model.vocab_size, lo=2, hi=12)
+    budgets = [int(rng.integers(1, 8)) for _ in prompts]
+    # warmup burst: the SAME stream first runs unguarded, so this test owns
+    # its compiles and stays green under -k / --lf / reordering instead of
+    # leaning on earlier tests having warmed the prefill buckets
+    for r in [batcher.submit(p, n) for p, n in zip(prompts, budgets)]:
+        r.result(timeout=120)
+    with lint_rt.retrace_guard(steady=True) as sentinel:
+        reqs = [batcher.submit(p, n) for p, n in zip(prompts, budgets)]
+        outs = [r.result(timeout=120) for r in reqs]
+    assert sentinel.violations == []
+    # the offline oracle runs OUTSIDE the guard: its contiguous decode
+    # buffers are shaped per (prompt+n) and legitimately compile fresh
+    for p, out in zip(prompts, outs):
+        assert out == _offline_greedy(cfg, params, p, len(out))
     _assert_drained(engine, batcher)
 
 

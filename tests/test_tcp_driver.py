@@ -4,13 +4,20 @@ localhost sockets with node agents on threads, dead-node synthesis."""
 import socket
 import threading
 import time
+import zlib
 
 import numpy as np
 import pytest
 
 from photon_tpu.federation import NodeAgent, ParamTransport, ServerApp
 from photon_tpu.federation.messages import Ack, Envelope, Query
-from photon_tpu.federation.tcp import HELLO_KIND, SocketConn, TcpServerDriver
+from photon_tpu.federation.tcp import (
+    _FRAME,
+    HELLO_KIND,
+    CorruptFrameError,
+    SocketConn,
+    TcpServerDriver,
+)
 from tests.test_federation import make_cfg
 
 pytestmark = pytest.mark.slow
@@ -51,6 +58,116 @@ def test_wait_for_nodes_times_out():
     with pytest.raises(TimeoutError):
         driver.wait_for_nodes(timeout=0.3)
     driver.shutdown()
+
+
+def test_recv_deadline_defeats_slow_drip():
+    """The HELLO deadline is absolute, not per-recv: a peer dripping one
+    byte per interval resets a plain settimeout forever but must still trip
+    the deadline (otherwise it monopolizes the accept loop indefinitely)."""
+    a, b = socket.socketpair()
+    conn = SocketConn(a)
+    conn.deadline = time.monotonic() + 0.4
+    stop = threading.Event()
+
+    def drip():
+        # a plausible 64-byte frame header, then one byte at a time
+        b.sendall(b"\x40" + b"\x00" * 11)
+        while not stop.is_set():
+            try:
+                b.sendall(b"x")
+            except OSError:
+                return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=drip, name="drip", daemon=True)
+    t.start()
+    start = time.monotonic()
+    try:
+        with pytest.raises(socket.timeout):
+            conn.recv()
+        assert time.monotonic() - start < 5.0
+    finally:
+        stop.set()
+        conn.close()
+        b.close()
+        t.join(timeout=5)
+
+
+def test_malformed_hello_does_not_kill_accept_loop():
+    """A version-skewed client's HELLO missing node_id (or carrying garbage
+    stats) must drop that one connection — never KeyError the accept thread
+    to death, which would silently stop ALL future registrations."""
+    driver = TcpServerDriver("127.0.0.1", 0, expected_nodes=1)
+    try:
+        for bad in (
+            {"kind": HELLO_KIND},  # no node_id
+            "not even a dict",
+            {"kind": HELLO_KIND, "node_id": "n9", "reconnects": "garbage"},
+        ):
+            sock = socket.create_connection(("127.0.0.1", driver.port), timeout=10)
+            conn = SocketConn(sock)
+            conn.send(bad)
+            if isinstance(bad, dict) and bad.get("node_id") is None:
+                # rejected HELLOs get their socket closed server-side
+                sock.settimeout(5)
+                with pytest.raises((EOFError, OSError)):
+                    conn.recv()
+            conn.close()
+        # the accept thread survived: a well-formed node still registers
+        sock = socket.create_connection(("127.0.0.1", driver.port), timeout=10)
+        good = SocketConn(sock)
+        good.send({"kind": HELLO_KIND, "node_id": "n1"})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and "n1" not in driver.node_ids():
+            time.sleep(0.05)
+        # n9's HELLO was well-formed apart from its stats: it registers with
+        # the stats coerced to zero; n1 proves the accept loop is still alive
+        assert set(driver.node_ids()) == {"n1", "n9"}
+        assert driver.hello_stats()["n9"] == {"reconnects": 0, "backoff_s": 0.0}
+        good.close()
+    finally:
+        driver.shutdown()
+
+
+#: protocol-0 pickle whose GLOBAL opcode references a missing module —
+#: pickle.loads raises ModuleNotFoundError, NOT UnpicklingError, which is
+#: exactly what a version-skewed peer's renamed class produces
+_UNPICKLABLE = b"cnosuchmodule_photon\nNoSuchCls\n."
+
+
+def test_unpicklable_frame_is_corrupt_frame_error():
+    """A CRC-valid but undecodable frame must surface as CorruptFrameError
+    (an EOFError: every caller already tears the connection down on it),
+    never leak ModuleNotFoundError into recv callers."""
+    a, b = socket.socketpair()
+    ca, cb = SocketConn(a), SocketConn(b)
+    a.sendall(_FRAME.pack(len(_UNPICKLABLE), zlib.crc32(_UNPICKLABLE)) + _UNPICKLABLE)
+    with pytest.raises(CorruptFrameError):
+        cb.recv()
+    ca.close(); cb.close()
+
+
+def test_unpicklable_hello_does_not_kill_accept_loop():
+    """The accept loop's HELLO catch is (EOFError, OSError): an unpicklable
+    HELLO must arrive as CorruptFrameError and drop one connection, not kill
+    the accept thread and silently stop all future registrations."""
+    driver = TcpServerDriver("127.0.0.1", 0, expected_nodes=1)
+    try:
+        sock = socket.create_connection(("127.0.0.1", driver.port), timeout=10)
+        sock.sendall(_FRAME.pack(len(_UNPICKLABLE), zlib.crc32(_UNPICKLABLE)) + _UNPICKLABLE)
+        sock.settimeout(5)
+        assert sock.recv(1) == b""  # server dropped the connection
+        sock.close()
+        # the accept thread survived: a well-formed node still registers
+        good = SocketConn(socket.create_connection(("127.0.0.1", driver.port), timeout=10))
+        good.send({"kind": HELLO_KIND, "node_id": "n1"})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and "n1" not in driver.node_ids():
+            time.sleep(0.05)
+        assert driver.node_ids() == ["n1"]
+        good.close()
+    finally:
+        driver.shutdown()
 
 
 def test_tcp_fed_round(tmp_path):
