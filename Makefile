@@ -1,7 +1,7 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test test-all bench bench-host bench-telemetry chaos telemetry-smoke serve-smoke lint lint-tests native clean
+.PHONY: test test-all bench bench-host bench-telemetry bench-collective chaos telemetry-smoke serve-smoke lint lint-tests native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
@@ -26,6 +26,12 @@ bench-host:
 # the disabled hook-site ns); CPU-runnable, no relay/TPU claim
 bench-telemetry:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --telemetry-overhead
+
+# device-collective aggregation report only (ISSUE 7: flat fp32 psum vs
+# hierarchical q8 on an emulated 8-device CPU client mesh); exit code
+# asserts the >=3.5x modeled cross-slice byte reduction at q8
+bench-collective:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --collective
 
 # telemetry smoke (ISSUE 4): the whole tracing/event/registry suite — the
 # fast half (in-process 1-round run → merged Perfetto trace parses with

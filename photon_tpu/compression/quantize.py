@@ -16,6 +16,12 @@ import numpy as np
 DEFAULT_BLOCK = 256
 _QMAX = 127.0
 
+#: valid ``comm_stack.collective_quantization`` policies — the single source
+#: of truth for config validation (jax-free) AND the collective plane
+#: (``parallel/collective_agg.py``); "q8" is this module's codec applied
+#: inside the cross-slice exchange
+COLLECTIVE_QUANTIZATIONS = ("off", "q8")
+
 
 def quantize_q8(values: np.ndarray, block: int = DEFAULT_BLOCK) -> tuple[np.ndarray, np.ndarray]:
     """Flat fp vector → ``(int8 codes, fp32 per-block scales)``."""
@@ -24,15 +30,23 @@ def quantize_q8(values: np.ndarray, block: int = DEFAULT_BLOCK) -> tuple[np.ndar
     flat = np.asarray(values, dtype=np.float32).reshape(-1)
     n = flat.size
     n_blocks = max(1, -(-n // block))
-    padded = np.zeros(n_blocks * block, dtype=np.float32)
-    padded[:n] = flat
-    grid = padded.reshape(n_blocks, block)
+    aligned = bool(n) and n % block == 0
+    if aligned:
+        # block-aligned input (every wire-encode of a pow2-sized layer):
+        # reshape is a view — the full-size padded fp32 copy never exists
+        grid = flat.reshape(n_blocks, block)
+    else:
+        padded = np.zeros(n_blocks * block, dtype=np.float32)
+        padded[:n] = flat
+        grid = padded.reshape(n_blocks, block)
     absmax = np.abs(grid).max(axis=1)
     scales = (absmax / _QMAX).astype(np.float32)
     # all-zero blocks: scale 0; divide guarded so codes stay 0
     safe = np.where(scales > 0, scales, 1.0)[:, None]
     codes = np.clip(np.rint(grid / safe), -_QMAX, _QMAX).astype(np.int8)
-    return codes.reshape(-1)[:n].copy(), scales
+    # codes is freshly allocated either way; only the ragged tail needs the
+    # defensive copy (slicing a view of the padded grid)
+    return (codes.reshape(-1) if aligned else codes.reshape(-1)[:n].copy()), scales
 
 
 def dequantize_q8(codes: np.ndarray, scales: np.ndarray, block: int = DEFAULT_BLOCK) -> np.ndarray:
@@ -43,6 +57,11 @@ def dequantize_q8(codes: np.ndarray, scales: np.ndarray, block: int = DEFAULT_BL
     scales = np.asarray(scales, dtype=np.float32)
     if scales.size != n_blocks:
         raise ValueError(f"expected {n_blocks} scales for {n} codes, got {scales.size}")
+    if n and n % block == 0:
+        # aligned: astype already allocates the fresh fp32 buffer — skip the
+        # extra zero-filled copy the ragged path pays for the padding
+        out = codes.astype(np.float32).reshape(n_blocks, block) * scales[:, None]
+        return out.reshape(-1)
     padded = np.zeros(n_blocks * block, dtype=np.float32)
     padded[:n] = codes.astype(np.float32)
     out = padded.reshape(n_blocks, block) * scales[:, None]

@@ -1,0 +1,63 @@
+"""jnp port of the blockwise-int8 quantizer, for use INSIDE collectives.
+
+The device-resident aggregation plane (``parallel/collective_agg.py``)
+quantizes each slice's contribution before the cross-slice DCN exchange
+(EQuARX, PAPERS.md). The codec must be the SAME codec as the host wire
+path so the error analysis carries over, so this module is a line-for-line
+``jnp`` port of :mod:`photon_tpu.compression.quantize` — it imports
+``DEFAULT_BLOCK`` / ``_QMAX`` from there (single source of truth) and a
+golden test (``tests/test_compression.py``) pins numpy↔jnp parity
+byte-exact on CPU: identical int8 codes, identical fp32 scales, including
+the ragged final block and the all-zero-block (scale 0) cases.
+
+Shapes are static under tracing, so the ragged-tail padding resolves at
+trace time — inside a jitted collective the caller pads to a block
+multiple up front and these functions reduce to pure vector ops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from photon_tpu.compression.quantize import DEFAULT_BLOCK, _QMAX
+
+
+def quantize_q8_jnp(values: jnp.ndarray, block: int = DEFAULT_BLOCK):
+    """Flat fp vector → ``(int8 codes, fp32 per-block scales)``.
+
+    Port parity notes: ``jnp.rint`` and ``np.rint`` both round half to
+    even; the clip bound is the float ``±127.0`` exactly as in the numpy
+    path, so the int8 cast sees identical integral floats.
+    """
+    if block < 1:
+        raise ValueError(f"q8 block must be >= 1, got {block}")
+    flat = jnp.asarray(values, dtype=jnp.float32).reshape(-1)
+    n = flat.size
+    n_blocks = max(1, -(-n // block))
+    pad = n_blocks * block - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    grid = flat.reshape(n_blocks, block)
+    absmax = jnp.max(jnp.abs(grid), axis=1)
+    scales = (absmax / _QMAX).astype(jnp.float32)
+    # all-zero blocks: scale 0; divide guarded so codes stay 0
+    safe = jnp.where(scales > 0, scales, 1.0)[:, None]
+    codes = jnp.clip(jnp.rint(grid / safe), -_QMAX, _QMAX).astype(jnp.int8)
+    return codes.reshape(-1)[:n], scales
+
+
+def dequantize_q8_jnp(codes: jnp.ndarray, scales: jnp.ndarray,
+                      block: int = DEFAULT_BLOCK) -> jnp.ndarray:
+    """Inverse of :func:`quantize_q8_jnp`; returns a flat fp32 vector."""
+    codes = jnp.asarray(codes, dtype=jnp.int8).reshape(-1)
+    n = codes.size
+    n_blocks = max(1, -(-n // block))
+    if scales.size != n_blocks:
+        raise ValueError(f"expected {n_blocks} scales for {n} codes, got {scales.size}")
+    scales = jnp.asarray(scales, dtype=jnp.float32)
+    flat = codes.astype(jnp.float32)
+    pad = n_blocks * block - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    out = flat.reshape(n_blocks, block) * scales[:, None]
+    return out.reshape(-1)[:n]

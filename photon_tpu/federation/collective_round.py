@@ -4,10 +4,13 @@ Consumer of ``photon.comm_stack.collective`` (SURVEY §7 stage 6, the marquee
 path): where the driver topology moves every client's parameters through a
 pointer plane (shm / objstore) and averages on the server host
 (``strategy/aggregation.py``), slices that are part of one
-``jax.distributed`` job aggregate with a weighted ``psum`` over a
-``clients`` mesh axis (``parallel/collective_agg.py``) — no host round-trip,
-no object store; the replicated result doubles as the next round's
-broadcast (reference upload/download + broadcast:
+``jax.distributed`` job aggregate over a hierarchical ``(clients, replica)``
+mesh (``parallel/collective_agg.py``) — intra-slice over ICI, cross-slice
+over DCN, optionally int8-quantized on the DCN leg
+(``comm_stack.collective_quantization``), with the server optimizer fused
+into the same SPMD program when ``collective_device_optimizer`` is on — no
+host round-trip, no object store; the replicated result doubles as the next
+round's broadcast (reference upload/download + broadcast:
 ``s3_utils.py:730-1115``, ``broadcast_utils.py:60-201``).
 
 Topology: multi-controller SPMD. Every process runs THIS SAME loop over its
@@ -39,12 +42,19 @@ from typing import Sequence
 import jax
 import numpy as np
 
+from photon_tpu import telemetry
+from photon_tpu.analysis.runtime import steady_point
 from photon_tpu.codec import params_to_ndarrays
+from photon_tpu.compression.quantize import DEFAULT_BLOCK
 from photon_tpu.config.schema import Config
 from photon_tpu.federation.client_runtime import ClientRuntime
 from photon_tpu.federation.messages import FitIns
 from photon_tpu.utils.profiling import (
     COLLECTIVE_AGG_TIME,
+    COLLECTIVE_EXCHANGE_TIME,
+    COLLECTIVE_STACK_TIME,
+    COLLECTIVE_UPDATE_TIME,
+    COLLECTIVE_WIRE_BYTES,
     EVAL_LOSS,
     EVAL_SAMPLES,
     FIT_ROUND_TIME,
@@ -55,8 +65,11 @@ from photon_tpu.federation.transport import ParamTransport
 from photon_tpu.metrics.history import History
 from photon_tpu.parallel.collective_agg import (
     CLIENT_AXIS,
-    collective_weighted_average,
-    make_client_mesh,
+    DeviceAggregationPlane,
+    hierarchical_weighted_average,
+    make_hierarchical_mesh,
+    mesh_replica,
+    modeled_cross_slice_bytes,
 )
 from photon_tpu.strategy import dispatch_strategy
 
@@ -102,6 +115,9 @@ class CollectiveFedRunner:
                 "this process owns no clients — launch with num_processes <= "
                 "n_total_clients so every controller contributes psum rows"
             )
+        cs = cfg.photon.comm_stack
+        self.quantization = cs.collective_quantization
+        self.q8_block = cs.collective_q8_block or DEFAULT_BLOCK
         self.mesh = mesh if mesh is not None else self._default_mesh()
         # inline transport: params never leave this process except via psum
         self.transport = ParamTransport("inline")
@@ -129,8 +145,39 @@ class CollectiveFedRunner:
             if not has_momenta(self.meta):
                 self.meta, initial = extend_with_momenta(self.meta, initial)
         self.strategy.initialize(initial)
+        # second-moment rows must leave the server >= 0 (clients sqrt them):
+        # true at fp32, but q8 rounding noise turns the exactly-zero
+        # pseudo-gradient of idle m2 elements tiny-nonzero and the adaptive
+        # server rules then step them negative (NaN by round 3, observed).
+        # Both optimizer paths clamp these rows on the q8 policy only — at
+        # `off` the invariant holds by construction and clamping would break
+        # the bit-exact parity pins.
+        from photon_tpu.train.param_ops import M2_PREFIX
+
+        self._nonneg_rows = tuple(
+            i for i, n in enumerate(self.meta.names) if n.startswith(M2_PREFIX)
+        )
+        # device-resident server optimizer (parallel/collective_agg.py): the
+        # whole average → pseudo-grad → update round runs as one fused SPMD
+        # program with optimizer state on device; the host strategy replica
+        # stays the broadcast/checkpoint mirror (synced after every round)
+        self.device_plane = (
+            DeviceAggregationPlane(
+                self.mesh, self.strategy,
+                quantization=self.quantization, block=self.q8_block,
+                nonneg_rows=self._nonneg_rows,
+            )
+            if cs.collective_device_optimizer
+            else None
+        )
         self.history = History()
         self.server_steps_cumulative = 0
+        # per-client control state (sample/step counters), exactly as the
+        # driver topology's ServerApp keeps it: rides FitIns so a fresh
+        # loader after a restart fast-forwards to the client's cumulative
+        # sample position (ClientRuntime fit), and rides the checkpoint so
+        # resume replays the same data order
+        self.client_states: dict[int, dict] = {}
         self._warmup_collective()
 
     def _warmup_collective(self) -> None:
@@ -151,29 +198,33 @@ class CollectiveFedRunner:
         probe = jax.make_array_from_process_local_data(
             sharding, np.ones((len(self.process_cids), 1), np.float32), (n, 1)
         )
-        avg = collective_weighted_average([probe], ones, self.mesh)
+        avg = hierarchical_weighted_average([probe], ones, self.mesh)
         np.asarray(avg[0])  # block: the context exists once this returns
 
     def _default_mesh(self):
         """Client mesh whose device order matches :func:`partition_cids`:
-        row i of the stacked arrays must land on a device ADDRESSABLE by the
+        row i of the stacked arrays must land on devices ADDRESSABLE by the
         process that owns cid i, and every process must contribute exactly
-        ``len(process_cids)`` devices — ``jax.devices()[:n]`` breaks both
-        whenever local device counts differ from local cid counts (e.g. 2
-        hosts x 4 chips with 4 clients)."""
+        ``len(process_cids) × collective_replica`` devices —
+        ``jax.devices()[:n]`` breaks both whenever local device counts
+        differ from local cid counts (e.g. 2 hosts x 4 chips with 4
+        clients). With ``collective_replica > 1`` each client row widens to
+        its slice's ICI ranks (the hierarchical topology)."""
         n_total = self.cfg.fl.n_total_clients
+        replica = self.cfg.photon.comm_stack.collective_replica
         n_proc = jax.process_count()
         devices = []
         for p in range(n_proc):
-            want = len(partition_cids(n_total, n_proc, p))
+            want = len(partition_cids(n_total, n_proc, p)) * replica
             local = [d for d in jax.devices() if d.process_index == p]
             if len(local) < want:
                 raise ValueError(
-                    f"process {p} owns {want} clients but only {len(local)} "
-                    f"devices — rebalance clients or add devices"
+                    f"process {p} owns {want} device slots ({replica} per "
+                    f"client) but only {len(local)} devices — rebalance "
+                    "clients, lower collective_replica, or add devices"
                 )
             devices.extend(local[:want])
-        return make_client_mesh(n_total, devices)
+        return make_hierarchical_mesh(n_total, replica, devices)
 
     # ------------------------------------------------------------------
     def _stack_local(self, rows: list[list[np.ndarray]]) -> list[jax.Array]:
@@ -214,6 +265,9 @@ class CollectiveFedRunner:
                 params=None,
                 local_steps=cfg.fl.local_steps,
                 server_steps_cumulative=self.server_steps_cumulative,
+                client_states=(
+                    {cid: self.client_states[cid]} if cid in self.client_states else {}
+                ),
                 config=dict(cfg.fl.fit_config),
             )
             res = self.runtime.fit(ins, cid)
@@ -223,6 +277,8 @@ class CollectiveFedRunner:
                 raise RuntimeError(
                     f"collective round {server_round}: cid {cid} failed: {res.error}"
                 )
+            if res.client_state:
+                self.client_states[res.cid] = res.client_state
             _, arrays = self.transport.get(res.params)
             rows.append(arrays)
             ns.append(res.n_samples)
@@ -230,24 +286,81 @@ class CollectiveFedRunner:
 
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        stacked = self._stack_local(rows)
-        ns_global = jax.make_array_from_process_local_data(
-            NamedSharding(self.mesh, P(CLIENT_AXIS)),
-            np.asarray(ns, np.int32),
-            (cfg.fl.n_total_clients,),
-        )
         t_agg = time.monotonic()
-        # Σn rides the same SPMD program as one extra psum output — a
-        # separate collective per round would double the rendezvous cost
-        avg_dev, total_dev = collective_weighted_average(
-            stacked, ns_global, self.mesh, return_total=True
-        )
-        # replicated outputs → every controller fetches identical values
-        avg = [np.asarray(a) for a in avg_dev]
-        n_total = int(np.asarray(total_dev))
+        with telemetry.span(COLLECTIVE_STACK_TIME):
+            t_stage = time.monotonic()
+            stacked = self._stack_local(rows)
+            ns_global = jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, P(CLIENT_AXIS)),
+                np.asarray(ns, np.int32),
+                (cfg.fl.n_total_clients,),
+            )
+            stack_s = time.monotonic() - t_stage
 
-        metrics = self.strategy.apply_average(
-            server_round, avg, n_total, cfg.fl.n_total_clients
+        if self.device_plane is not None:
+            # fused path: average + pseudo-grad + server optimizer as ONE
+            # jitted SPMD program, state resident on device
+            with telemetry.span(COLLECTIVE_EXCHANGE_TIME):
+                t_stage = time.monotonic()
+                metrics = self.device_plane.run_round(
+                    stacked, ns_global,
+                    lr=self.strategy.effective_lr(cfg.fl.n_total_clients),
+                )
+                exchange_s = time.monotonic() - t_stage
+            with telemetry.span(COLLECTIVE_UPDATE_TIME):
+                t_stage = time.monotonic()
+                # host mirror: the next broadcast and any checkpoint read
+                # strategy.current_parameters (replicated outputs → every
+                # controller fetches identical values)
+                self.device_plane.sync_strategy(self.strategy)
+                self.strategy.server_round = server_round
+                update_s = time.monotonic() - t_stage
+        else:
+            # host-optimizer path: the collective carries the (optionally
+            # quantized) average; the strategy replica updates on host.
+            # Σn rides the same SPMD program as one extra psum output — a
+            # separate collective per round would double the rendezvous cost
+            with telemetry.span(COLLECTIVE_EXCHANGE_TIME):
+                t_stage = time.monotonic()
+                avg_dev, total_dev = hierarchical_weighted_average(
+                    stacked, ns_global, self.mesh,
+                    quantization=self.quantization, block=self.q8_block,
+                    return_total=True,
+                )
+                # wait for the collective HERE so exchange_time means the
+                # same thing on both optimizer paths (the device path blocks
+                # on its scalar fetches inside run_round); the device→host
+                # payload copy belongs to the update bucket, mirroring the
+                # device path's sync_strategy fetch
+                jax.block_until_ready(avg_dev)
+                exchange_s = time.monotonic() - t_stage
+            with telemetry.span(COLLECTIVE_UPDATE_TIME):
+                t_stage = time.monotonic()
+                avg = [np.asarray(a) for a in avg_dev]
+                n_total = int(np.asarray(total_dev))
+                metrics = self.strategy.apply_average(
+                    server_round, avg, n_total, cfg.fl.n_total_clients
+                )
+                if self.quantization == "q8":
+                    # same second-moment clamp as the device plane (see
+                    # __init__) — apply_average returns fresh arrays, so
+                    # in-place is safe
+                    for i in self._nonneg_rows:
+                        p = self.strategy.current_parameters[i]
+                        np.maximum(p, 0.0, out=p)
+                update_s = time.monotonic() - t_stage
+
+        metrics[COLLECTIVE_STACK_TIME] = stack_s
+        metrics[COLLECTIVE_EXCHANGE_TIME] = exchange_s
+        metrics[COLLECTIVE_UPDATE_TIME] = update_s
+        metrics[COLLECTIVE_WIRE_BYTES] = float(
+            modeled_cross_slice_bytes(
+                [int(np.prod(r.shape, dtype=np.int64)) for r in rows[0]],
+                cfg.fl.n_total_clients,
+                replica=mesh_replica(self.mesh),
+                quantization=self.quantization,
+                block=self.q8_block,
+            )
         )
         metrics[COLLECTIVE_AGG_TIME] = time.monotonic() - t_agg
         metrics[FIT_ROUND_TIME] = time.monotonic() - t_fit
@@ -255,7 +368,50 @@ class CollectiveFedRunner:
         metrics[STEPS_CUMULATIVE] = float(self.server_steps_cumulative)
         metrics[ROUND_TIME] = time.monotonic() - t_round
         self.history.record(server_round, metrics)
+        steady_point("collective/round")
         return metrics
+
+    # -- checkpoint bridge --------------------------------------------------
+    def state_for_checkpoint(self):
+        """Strategy state ready to serialize. On the device-optimizer path
+        the state already mirrors to the host strategy after every round
+        (:meth:`DeviceAggregationPlane.sync_strategy`), so this is exactly
+        ``Strategy.state_for_checkpoint`` — same keys, same ``_t`` handling
+        — and a checkpoint written here resumes through
+        :meth:`load_server_state` on either path."""
+        return self.strategy.state_for_checkpoint()
+
+    def control_state_for_checkpoint(self) -> dict:
+        """The non-tensor control snapshot a resume needs alongside the
+        strategy state — same vocabulary as ``ServerApp.save_checkpoint``'s
+        ``server_state`` (client sample counters drive loader fast-forward
+        after a restart)."""
+        return {
+            "server_steps_cumulative": self.server_steps_cumulative,
+            "client_states": dict(self.client_states),
+        }
+
+    def load_server_state(self, parameters, state=None, control=None) -> None:
+        """Resume: re-seed the strategy replica (and, when enabled, the
+        device plane) from checkpointed parameters + optimizer state. The
+        adaptive strategies' ``_t`` rides ``state`` exactly as in the
+        driver topology, so bias correction stays continuous across the
+        restart; ``control`` (:meth:`control_state_for_checkpoint`) restores
+        the step counter and the per-client loader positions."""
+        self.strategy.initialize(parameters, state)
+        if control:
+            self.server_steps_cumulative = int(
+                control.get("server_steps_cumulative", self.server_steps_cumulative)
+            )
+            self.client_states = {
+                int(k): v for k, v in control.get("client_states", {}).items()
+            }
+        if self.device_plane is not None:
+            self.device_plane = DeviceAggregationPlane(
+                self.mesh, self.strategy,
+                quantization=self.quantization, block=self.q8_block,
+                nonneg_rows=self._nonneg_rows,
+            )
 
     def evaluate_round(self, server_round: int) -> dict[str, float]:
         """Fed eval over the collective: every controller scores its clients
@@ -290,7 +446,9 @@ class CollectiveFedRunner:
             np.asarray(ns, np.int32),
             (self.cfg.fl.n_total_clients,),
         )
-        avg, total = collective_weighted_average(
+        # losses are [1]-vectors — quantizing them would be all cost, no
+        # byte savings, so eval always rides the fp32 exchange
+        avg, total = hierarchical_weighted_average(
             [loss_global], ns_global, self.mesh, return_total=True
         )
         metrics = {

@@ -1,17 +1,50 @@
-"""Cross-slice collective aggregation: FedAvg as an allreduce over DCN/ICI.
+"""Device-resident aggregation plane: hierarchical ICI/DCN collectives.
 
 The marquee TPU-native path (SURVEY.md §7 stage 6): where the reference moves
 every client's full parameter list through S3/shm/Ray and averages on the
 server CPU (``strategy/aggregation.py:44-118``, ``s3_utils.py:730-1115``),
-TPU slices that are part of one ``jax.distributed`` job can aggregate with a
-single weighted ``psum`` over the ``clients`` mesh axis — no host round-trip,
-no object store, bandwidth = wire speed of ICI/DCN.
+TPU slices that are part of one ``jax.distributed`` job aggregate with XLA
+collectives — no host round-trip, no object store, bandwidth = wire speed of
+ICI/DCN.
 
-Usage model: each client trains its slice; at the round boundary all clients
-enter :func:`collective_weighted_average` (an SPMD program over the joint
-mesh). Single-host tests fake the topology with CPU devices; multi-host runs
-build the same mesh from ``jax.distributed.initialize`` + per-process devices
-(``make_client_mesh``).
+Three layers, each the degenerate case of the next:
+
+1. **Flat fp32 psum** (:func:`collective_weighted_average`): a 1-D
+   ``clients`` mesh, one weighted ``psum`` per pytree leaf. The original
+   path; every program below reproduces it bit-exactly at ``replica=1`` /
+   ``quantization="off"``.
+2. **Hierarchical two-stage reduce** (:func:`hierarchical_weighted_average`):
+   a 2-D ``(clients, replica)`` mesh (:func:`make_hierarchical_mesh`) where
+   ``clients`` is the cross-slice DCN axis and ``replica`` the intra-slice
+   ICI axis. Each client's contribution is reduce-scattered over ICI (each
+   of the ``replica`` ranks owns ``1/replica`` of the flat vector), the
+   cross-slice reduction runs per-rank over DCN (``replica`` parallel
+   exchanges of ``1/replica`` the bytes — the classic hierarchical
+   allreduce), and an ICI all-gather reassembles the replicated result.
+3. **Quantized cross-slice exchange** (``quantization="q8"``): the DCN leg
+   ships blockwise-int8 codes + fp32 per-block scales instead of fp32
+   (EQuARX, PAPERS.md) — reduce-scatter → q8 encode → all-gather exchange →
+   dequant-accumulate → ICI all-gather. The codec is the jnp port of
+   ``compression/quantize.py`` (shared ``DEFAULT_BLOCK``/``_QMAX``; parity
+   pinned byte-exact), so the wire-plane error analysis carries over: per
+   element the cross-slice average errs by at most
+   ``Σ_c scale_c/2`` where ``scale_c = absmax(block of w_c·x_c)/127`` —
+   each client's rounding contributes ``scale/2`` per hop and the single
+   dequant-accumulate hop sums them. Modeled DCN bytes drop ~3.94x at the
+   default block of 256 (1 + 4/256 bytes/value vs 4).
+
+On top rides the **device-resident server optimizer**
+(:class:`DeviceAggregationPlane`): the average → pseudo-gradient →
+FedAvgEff/Nesterov/FedMom/FedAdam/FedYogi update runs fused in the SAME
+jitted SPMD program, with optimizer state living as replicated device
+arrays. ``strategy/optimizers.py`` stays the host oracle — the device rules
+mirror it op-for-op (tests pin parity bit-exact at ``off`` given the same
+average) and checkpoints round-trip through the existing host
+``Strategy.state_for_checkpoint``.
+
+Programs are built once per (mesh, structure, policy) and cached — a fresh
+``shard_map`` per round would retrace every round, which the PR 6
+``RetraceSentinel`` e2e now forbids from round 2.
 
 Numerics: weights ``n_i / Σn`` are computed in fp32 from per-client sample
 counts; the weighted sum runs in fp32 regardless of param dtype — matching
@@ -20,15 +53,38 @@ the reference's float accumulation (``aggregate_inplace``).
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from photon_tpu.compression.quantize import COLLECTIVE_QUANTIZATIONS, DEFAULT_BLOCK
+from photon_tpu.compression.quantize_jnp import quantize_q8_jnp
 
 CLIENT_AXIS = "clients"
+REPLICA_AXIS = "replica"
+
+
+def _full_shard_map(f: Callable, mesh: Mesh, in_specs, out_specs) -> Callable:
+    """Full-manual shard_map across jax versions (all mesh axes manual — the
+    partial-manual spelling aborts on this image's jax 0.4.37, see
+    ``parallel/context.partial_shard_map``; full-manual is safe on both)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False,
+    )
+
+
+# ---------------------------------------------------------------------------
+# meshes
+# ---------------------------------------------------------------------------
 
 
 def make_client_mesh(n_clients: int, devices: list | None = None) -> Mesh:
@@ -44,53 +100,208 @@ def make_client_mesh(n_clients: int, devices: list | None = None) -> Mesh:
     return Mesh(np.asarray(devices[:n_clients]), (CLIENT_AXIS,))
 
 
+def make_hierarchical_mesh(
+    n_clients: int, replica: int = 1, devices: list | None = None
+) -> Mesh:
+    """2-D ``(clients, replica)`` mesh: row c = client c's slice (its
+    ``replica`` ICI-connected chips), column axis = intra-slice ranks.
+
+    Multi-host: each process contributes its slice's devices contiguously so
+    row c lands on the process that owns cid c (the same device-order
+    contract as :func:`make_client_mesh`; see
+    ``CollectiveFedRunner._default_mesh``). ``replica=1`` is the degenerate
+    flat topology — same participant set as :func:`make_client_mesh`, and
+    the ``off`` average is pinned bit-exact against it.
+    """
+    if replica < 1:
+        raise ValueError(f"replica must be >= 1, got {replica}")
+    devices = devices if devices is not None else jax.devices()
+    need = n_clients * replica
+    if len(devices) < need:
+        raise ValueError(
+            f"need {need} devices for a ({n_clients}, {replica}) client mesh, "
+            f"have {len(devices)}"
+        )
+    grid = np.asarray(devices[:need]).reshape(n_clients, replica)
+    return Mesh(grid, (CLIENT_AXIS, REPLICA_AXIS))
+
+
+def mesh_replica(mesh: Mesh) -> int:
+    """ICI width of a client mesh (1 on the flat 1-D topology)."""
+    return int(mesh.shape[REPLICA_AXIS]) if REPLICA_AXIS in mesh.axis_names else 1
+
+
+# ---------------------------------------------------------------------------
+# hierarchical weighted average (the collective core)
+# ---------------------------------------------------------------------------
+
+
+def _check_one_row(ns_shape: tuple) -> None:
+    # make_*_mesh pins exactly one client per mesh row; the numerators below
+    # read only row 0, so a mesh packing >1 row per shard would drop clients
+    # while still counting their samples — fail loudly (trace-time check:
+    # shard shapes are static).
+    if ns_shape[0] != 1:
+        raise ValueError(
+            f"collective aggregation expects 1 client row per device shard, "
+            f"got {ns_shape[0]} — repack the client mesh"
+        )
+
+
+def _build_average_local(
+    mesh: Mesh, quantization: str, block: int
+) -> Callable:
+    """The per-device body of the (hierarchical, optionally quantized)
+    weighted average. Closure constants only — no traced branches."""
+    n_clients = int(mesh.shape[CLIENT_AXIS])
+    replica = mesh_replica(mesh)
+    has_replica = REPLICA_AXIS in mesh.axis_names
+
+    def _reduce_leaf(contrib: jnp.ndarray) -> jnp.ndarray:
+        """Weighted per-client contribution (one full row, replicated over
+        the ICI axis) → cross-client sum, replicated."""
+        shape = contrib.shape
+        if replica == 1 and quantization == "off":
+            # degenerate flat path: one fp32 psum, bit-compatible with the
+            # original 1-D program
+            return jax.lax.psum(contrib, CLIENT_AXIS)
+        flat = contrib.reshape(-1)
+        n = flat.size
+        if quantization == "q8":
+            # block-aligned chunks by construction: the q8 encode below
+            # never sees a ragged tail inside the collective
+            chunk = -(-n // (replica * block)) * block
+        else:
+            chunk = -(-n // replica)
+        pad = replica * chunk - n
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+        if has_replica:
+            # ICI reduce-scatter: rank r keeps chunk r of its slice's
+            # contribution (the row is replicated intra-slice, so the
+            # "reduce" is chunk selection; a data-parallel client whose
+            # ranks hold partials would psum over REPLICA_AXIS first)
+            r = jax.lax.axis_index(REPLICA_AXIS)
+            mychunk = jax.lax.dynamic_slice(flat, (r * chunk,), (chunk,))
+        else:
+            mychunk = flat
+        if quantization == "q8":
+            # cross-slice DCN leg: int8 codes + fp32/block scales on the
+            # wire instead of fp32 values (EQuARX)
+            codes, scales = quantize_q8_jnp(mychunk, block)
+            all_codes = jax.lax.all_gather(codes, CLIENT_AXIS)
+            all_scales = jax.lax.all_gather(scales, CLIENT_AXIS)
+            grid = all_codes.astype(jnp.float32).reshape(
+                n_clients, chunk // block, block
+            )
+            # dequant-accumulate: deterministic sum over the client axis
+            red = (grid * all_scales[:, :, None]).sum(axis=0).reshape(-1)
+        else:
+            red = jax.lax.psum(mychunk, CLIENT_AXIS)
+        if has_replica:
+            # ICI all-gather reassembles the full replicated vector
+            red = jax.lax.all_gather(red, REPLICA_AXIS, tiled=True)
+        return red[:n].reshape(shape)
+
+    def local(ns, *leaves):
+        # ns: [1] local sample count; leaves: [1, ...] rows (see
+        # _check_one_row); everything replicated along REPLICA_AXIS.
+        _check_one_row(ns.shape)
+        n_total = jax.lax.psum(jnp.sum(ns.astype(jnp.float32)), CLIENT_AXIS)
+        w = ns[0].astype(jnp.float32) / n_total
+        outs = tuple(
+            _reduce_leaf(leaf[0].astype(jnp.float32) * w) for leaf in leaves
+        )
+        return outs + (n_total,)
+
+    return local
+
+
+def _mapped_average(
+    mesh: Mesh, n_leaves: int, quantization: str, block: int
+) -> Callable:
+    """shard_map-wrapped (unjitted) average over ``n_leaves`` stacked leaves
+    plus the Σn psum — the single construction point for both the cached
+    standalone program and the fused device-optimizer program."""
+    local = _build_average_local(mesh, quantization, block)
+    return _full_shard_map(
+        local,
+        mesh,
+        in_specs=(P(CLIENT_AXIS),) + tuple(P(CLIENT_AXIS) for _ in range(n_leaves)),
+        out_specs=tuple(P() for _ in range(n_leaves)) + (P(),),
+    )
+
+
+#: (mesh, n_leaves, quantization, block) → jitted average program. Programs
+#: must be built once and reused: a fresh shard_map wrapper per call would
+#: retrace (and backend-compile) every round.
+_AVG_PROGRAMS: dict[tuple, Callable] = {}
+
+
+def _average_program(
+    mesh: Mesh, n_leaves: int, quantization: str, block: int
+) -> Callable:
+    key = (mesh, n_leaves, quantization, block)
+    prog = _AVG_PROGRAMS.get(key)
+    if prog is None:
+        prog = jax.jit(_mapped_average(mesh, n_leaves, quantization, block))
+        _AVG_PROGRAMS[key] = prog
+    return prog
+
+
+def hierarchical_weighted_average(
+    stacked_params: Any,
+    n_samples: jax.Array,
+    mesh: Mesh,
+    quantization: str = "off",
+    block: int = DEFAULT_BLOCK,
+    return_total: bool = False,
+) -> Any:
+    """Sample-weighted average over the client axis, hierarchical over the
+    replica (ICI) axis when the mesh has one, optionally int8-quantized on
+    the cross-slice (DCN) leg.
+
+    ``stacked_params``: pytree whose leaves are ``[n_clients, ...]`` arrays
+    sharded on the client axis (each slice contributes its row).
+    ``n_samples``: ``[n_clients] int`` sharded likewise.
+    Returns the averaged pytree (leaves ``[...]`` fp32, replicated) — every
+    slice ends the round holding identical new globals, which also replaces
+    the reference's post-aggregation broadcast (``broadcast_utils.py``).
+    With ``return_total`` the replicated Σn rides the SAME program as one
+    extra psum output (callers need it for metrics; a separate collective
+    per round would be a second rendezvous).
+    """
+    if quantization not in COLLECTIVE_QUANTIZATIONS:
+        raise ValueError(
+            f"quantization must be one of {COLLECTIVE_QUANTIZATIONS}, got "
+            f"{quantization!r}"
+        )
+    if block < 1:
+        # callers resolve the config's 0-means-default sentinel before here
+        # (CollectiveFedRunner.q8_block); 0 would otherwise die as a bare
+        # ZeroDivisionError in the chunk math
+        raise ValueError(f"block must be >= 1, got {block}")
+    flat, treedef = jax.tree_util.tree_flatten(stacked_params)
+    prog = _average_program(mesh, len(flat), quantization, block)
+    out_flat = prog(n_samples, *flat)
+    avg = jax.tree_util.tree_unflatten(treedef, list(out_flat[:-1]))
+    if return_total:
+        return avg, out_flat[-1]
+    return avg
+
+
 def collective_weighted_average(
     stacked_params: Any,
     n_samples: jax.Array,
     mesh: Mesh,
     return_total: bool = False,
 ) -> Any:
-    """Sample-weighted average over the client axis, one psum per pytree.
-
-    ``stacked_params``: pytree whose leaves are ``[n_clients, ...]`` arrays
-    sharded on the client axis (each slice contributes its row).
-    ``n_samples``: ``[n_clients] int`` sharded likewise.
-    Returns the averaged pytree (leaves ``[...]``, replicated) — every client
-    slice ends the round holding identical new globals, which also replaces
-    the reference's post-aggregation broadcast (``broadcast_utils.py``).
-    With ``return_total`` the replicated Σn rides the SAME program as one
-    extra psum output (callers need it for metrics; a separate collective
-    per round would be a second trace + cross-process rendezvous).
-    """
-
-    def local(ns, *leaves):
-        # ns: [n_local] local sample counts; leaves: [n_local, ...] rows.
-        # make_client_mesh pins exactly one client per device; the numerator
-        # below reads only row 0, so a mesh packing >1 row per shard would
-        # drop clients while still counting their samples — fail loudly.
-        if ns.shape[0] != 1:
-            raise ValueError(
-                f"collective aggregation expects 1 client row per device "
-                f"shard, got {ns.shape[0]} — repack the client mesh"
-            )
-        n_total = jax.lax.psum(jnp.sum(ns.astype(jnp.float32)), CLIENT_AXIS)
-        w = ns[0].astype(jnp.float32) / n_total
-        outs = tuple(
-            jax.lax.psum(leaf[0].astype(jnp.float32) * w, CLIENT_AXIS) for leaf in leaves
-        )
-        return outs + (n_total,)
-
-    flat, treedef = jax.tree_util.tree_flatten(stacked_params)
-    out_flat = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(CLIENT_AXIS),) + tuple(P(CLIENT_AXIS) for _ in flat),
-        out_specs=tuple(P() for _ in flat) + (P(),),
-    )(n_samples, *flat)
-    avg = jax.tree_util.tree_unflatten(treedef, list(out_flat[:-1]))
-    if return_total:
-        return avg, out_flat[-1]
-    return avg
+    """The flat fp32 average (``quantization="off"``) — kept as the stable
+    entry point; on a hierarchical mesh it runs the two-stage reduce."""
+    return hierarchical_weighted_average(
+        stacked_params, n_samples, mesh, quantization="off",
+        return_total=return_total,
+    )
 
 
 def collective_fedavg_round(
@@ -100,12 +311,11 @@ def collective_fedavg_round(
     mesh: Mesh,
     server_lr: float = 1.0,
 ) -> Any:
-    """Full FedAvgEff round on device: weighted average → pseudo-gradient →
-    server SGD step (``x ← x − η(x − avg)``), all inside one jitted SPMD
-    program. With ``server_lr=1`` this is exact FedAvg. Adaptive server
-    optimizers keep their state host-side (strategy layer); this collective
-    path covers the FedAvg/Nesterov-μ=0 family where no server state exists
-    (the reference's federated default, ``conf/base.yaml:63-66``)."""
+    """Stateless FedAvgEff round on device: weighted average →
+    pseudo-gradient → server SGD step (``x ← x − η(x − avg)``). With
+    ``server_lr=1`` this is exact FedAvg. Stateful server optimizers run
+    through :class:`DeviceAggregationPlane` instead (fused average + update
+    + device-resident state)."""
     avg = collective_weighted_average(stacked_params, n_samples, mesh)
     return jax.tree.map(
         lambda x, a: (x.astype(jnp.float32) - server_lr * (x.astype(jnp.float32) - a)).astype(x.dtype),
@@ -116,7 +326,315 @@ def collective_fedavg_round(
 
 def stack_for_clients(host_params_per_client: list[Any], mesh: Mesh) -> Any:
     """Host-side helper (tests / single-host): stack per-client pytrees into
-    client-axis-sharded device arrays."""
+    client-axis-sharded device arrays (replicated along the replica axis)."""
     stacked = jax.tree.map(lambda *xs: np.stack(xs), *host_params_per_client)
     sharding = NamedSharding(mesh, P(CLIENT_AXIS))
     return jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)
+
+
+# ---------------------------------------------------------------------------
+# modeled DCN cost
+# ---------------------------------------------------------------------------
+
+
+def modeled_cross_slice_bytes(
+    sizes: Sequence[int],
+    n_clients: int,
+    replica: int = 1,
+    quantization: str = "off",
+    block: int = DEFAULT_BLOCK,
+) -> int:
+    """Idealized bytes crossing slice boundaries for one aggregation round:
+    every client's (padded) contribution crosses DCN exactly once, summed
+    over clients — algorithm-independent (a ring all-gather moves
+    ``(C-1)/C`` of this per participant; a tree psum about the same), so
+    the fp32-vs-q8 RATIO is what the model is for. ``sizes`` are per-leaf
+    element counts. The hierarchy (``replica``) splits the exchange across
+    ICI ranks without changing the total, exactly as on hardware."""
+    total = 0
+    for n in sizes:
+        n = int(n)
+        if quantization == "q8":
+            chunk = -(-n // (replica * block)) * block
+            padded = replica * chunk
+            total += padded + (padded // block) * 4
+        else:
+            total += -(-n // replica) * replica * 4
+    return total * int(n_clients)
+
+
+# ---------------------------------------------------------------------------
+# device-resident server optimizers (fused with the average)
+# ---------------------------------------------------------------------------
+
+#: strategy.name → state tensor lists the device plane carries (mirrors
+#: ``strategy/optimizers.py`` ``state_keys``)
+DEVICE_RULES: dict[str, tuple[str, ...]] = {
+    "fedavg": (),
+    "nesterov": ("momentum",),
+    "fedmom": ("momentum",),
+    "fedadam": ("momentum_1", "momentum_2"),
+    "fedyogi": ("momentum_1", "momentum_2"),
+}
+
+
+def device_server_update(
+    rule: str,
+    params: Sequence[jnp.ndarray],
+    grads: Sequence[jnp.ndarray],
+    state: dict[str, Sequence[jnp.ndarray]],
+    lr: jnp.ndarray,
+    b1t: jnp.ndarray,
+    b2t: jnp.ndarray,
+    momentum: float = 0.0,
+    beta_1: float = 0.9,
+    beta_2: float = 0.99,
+    tau: float = 1.0e-9,
+) -> tuple[list[jnp.ndarray], dict[str, list[jnp.ndarray]]]:
+    """jnp port of the five host update rules, op-for-op
+    (``strategy/optimizers.py`` is the oracle; parity tests pin each rule
+    bit-exact on CPU given the same average). ``g`` is the pseudo-gradient
+    ``x − avg``; ``b1t``/``b2t`` are the host-computed bias corrections
+    ``1 − β^t`` (fp64 on host, cast to fp32 exactly as numpy casts its
+    python-float scalars) so the adaptive rules stay retrace-free — the
+    round counter never enters the traced program as a Python int."""
+    if rule == "fedavg":
+        return [x - lr * g for x, g in zip(params, grads)], {}
+    if rule in ("nesterov", "fedmom"):
+        new_m = [momentum * m + g for m, g in zip(state["momentum"], grads)]
+        if rule == "nesterov":
+            new_p = [
+                x - lr * (g + momentum * m)
+                for x, g, m in zip(params, grads, new_m)
+            ]
+        else:
+            new_p = [x - lr * m for x, m in zip(params, new_m)]
+        return new_p, {"momentum": new_m}
+    if rule not in ("fedadam", "fedyogi"):
+        raise ValueError(f"no device update rule for strategy {rule!r}")
+    new_m1 = [
+        beta_1 * m + (1.0 - beta_1) * g
+        for m, g in zip(state["momentum_1"], grads)
+    ]
+    if rule == "fedadam":
+        new_m2 = [
+            beta_2 * v + (1.0 - beta_2) * jnp.square(g)
+            for v, g in zip(state["momentum_2"], grads)
+        ]
+    else:
+        new_m2 = []
+        for v, g in zip(state["momentum_2"], grads):
+            g2 = jnp.square(g)
+            new_m2.append(v - (1.0 - beta_2) * g2 * jnp.sign(v - g2))
+    new_p = [
+        x - lr * (m / b1t) / (jnp.sqrt(v / b2t) + tau)
+        for x, m, v in zip(params, new_m1, new_m2)
+    ]
+    return new_p, {"momentum_1": new_m1, "momentum_2": new_m2}
+
+
+class DeviceAggregationPlane:
+    """The fused server round as ONE jitted SPMD program: hierarchical
+    (optionally q8-quantized) weighted average → pseudo-gradient → server
+    optimizer update, with parameters AND optimizer state living as
+    replicated device arrays between rounds.
+
+    The host :class:`~photon_tpu.strategy.base.Strategy` instance supplies
+    the rule name + hyperparameters and stays the checkpoint authority:
+    :meth:`sync_strategy` pushes the device state (and the adaptive ``_t``
+    counter) back into it so ``Strategy.state_for_checkpoint`` round-trips
+    unchanged, and a strategy restored from a checkpoint seeds a fresh
+    plane via the constructor (bias-correction continuity pinned by test).
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        strategy: Any,
+        quantization: str = "off",
+        block: int = DEFAULT_BLOCK,
+        nonneg_rows: Sequence[int] = (),
+    ) -> None:
+        if strategy.name not in DEVICE_RULES:
+            raise ValueError(
+                f"strategy {strategy.name!r} has no device update rule "
+                f"(supported: {sorted(DEVICE_RULES)})"
+            )
+        if quantization not in COLLECTIVE_QUANTIZATIONS:
+            raise ValueError(
+                f"quantization must be one of {COLLECTIVE_QUANTIZATIONS}, "
+                f"got {quantization!r}"
+            )
+        if block < 1:
+            raise ValueError(f"block must be >= 1, got {block}")
+        if strategy.current_parameters is None:
+            raise RuntimeError("strategy not initialized with parameters")
+        self.mesh = mesh
+        self.rule = strategy.name
+        self.quantization = quantization
+        self.block = int(block)
+        self.state_keys = tuple(strategy.state_keys)
+        self.n_clients = int(mesh.shape[CLIENT_AXIS])
+        self.hyper = {
+            "momentum": float(strategy.momentum),
+            "beta_1": float(getattr(strategy, "beta_1", 0.9)),
+            "beta_2": float(getattr(strategy, "beta_2", 0.99)),
+            "tau": float(getattr(strategy, "tau", 1.0e-9)),
+        }
+        self.adaptive = self.rule in ("fedadam", "fedyogi")
+        #: server-update step counter (adaptive bias correction); seeded
+        #: from a restored strategy so resume keeps ``1 − β^t`` continuous
+        self.t = int(getattr(strategy, "_t", 0))
+        self._replicated = NamedSharding(mesh, P())
+        self.params: list[jax.Array] = [
+            jax.device_put(np.asarray(p, np.float32), self._replicated)
+            for p in strategy.current_parameters
+        ]
+        n_rows = len(self.params)
+        if any(not 0 <= int(i) < n_rows for i in nonneg_rows):
+            raise ValueError(
+                f"nonneg_rows out of range for a {n_rows}-row payload: "
+                f"{sorted(int(i) for i in nonneg_rows)}"
+            )
+        #: payload rows that must stay >= 0 (aggregated second moments in a
+        #: [params|m1|m2] payload). Only enforced on the q8 path: at `off`
+        #: the pseudo-gradient of an all-zero m2 element is exactly zero so
+        #: the adaptive rules leave it alone, but q8 rounding noise makes it
+        #: tiny-nonzero and the sign-like adaptive step then kicks the
+        #: element by ~lr — negative second moments NaN the clients'
+        #: sqrt(m2) on the next fit. Clamping at `off` would break the
+        #: bit-exact pins against the host oracle, which does not clamp.
+        self.nonneg_rows = tuple(sorted({int(i) for i in nonneg_rows}))
+        self.state: dict[str, list[jax.Array]] = {}
+        for key in self.state_keys:
+            host = strategy.state.get(key)
+            if host is None:
+                host = [np.zeros_like(np.asarray(p, np.float32)) for p in strategy.current_parameters]
+            self.state[key] = [
+                jax.device_put(np.asarray(a, np.float32), self._replicated)
+                for a in host
+            ]
+        self._program: Callable | None = None
+
+    # -- the fused program -------------------------------------------------
+    def _build_program(self, n_leaves: int) -> Callable:
+        mapped = _mapped_average(self.mesh, n_leaves, self.quantization, self.block)
+        rule, hyper = self.rule, dict(self.hyper)
+        clamp_rows = (
+            frozenset(self.nonneg_rows) if self.quantization == "q8" else frozenset()
+        )
+
+        def program(ns, stacked, params, state, lr, b1t, b2t):
+            out = mapped(ns, *stacked)
+            avgs, n_total = out[:-1], out[-1]
+            grads = [x - a for x, a in zip(params, avgs)]
+            new_params, new_state = device_server_update(
+                rule, params, grads, state, lr, b1t, b2t, **hyper
+            )
+            if clamp_rows:
+                # restore the second-moment invariant the q8 noise breaks
+                # (see __init__)
+                new_params = [
+                    jnp.maximum(p, 0.0) if i in clamp_rows else p
+                    for i, p in enumerate(new_params)
+                ]
+            # norm telemetry rides the same program as tiny replicated
+            # outputs (fp32 squared sums; host takes the sqrt — fp64 host
+            # norms and these agree to fp32 precision). param norm is over
+            # the PRE-update parameters, state norms over the post-update
+            # state — exactly what the host oracle's norm_telemetry sees
+            # when apply_average calls it (strategy/base.py), keeping the
+            # KPI meaning identical across the two optimizer paths
+            sq = {
+                "pseudo_grad": sum(jnp.sum(jnp.square(g)) for g in grads),
+                "param": sum(jnp.sum(jnp.square(p)) for p in params),
+            }
+            for key, tensors in new_state.items():
+                sq[key] = sum(jnp.sum(jnp.square(m)) for m in tensors)
+            return new_params, new_state, n_total, sq
+
+        return jax.jit(program)
+
+    def run_round(
+        self, stacked_flat: Sequence[jax.Array], n_samples: jax.Array, lr: float
+    ) -> dict[str, float]:
+        """One fused server round over client-axis-sharded stacked rows.
+        Updates the device-resident params/state in place and returns the
+        round metrics (the same vocabulary as the host
+        ``Strategy.apply_average``). Blocks until the program finishes (the
+        scalar fetches below synchronize)."""
+        if len(stacked_flat) != len(self.params):
+            raise ValueError(
+                f"stacked payload has {len(stacked_flat)} arrays, plane holds "
+                f"{len(self.params)} (momenta mismatch? the server extends "
+                "initial params with zero momenta when aggregate_momenta is on)"
+            )
+        if self._program is None:
+            self._program = self._build_program(len(self.params))
+        t_next = self.t + 1 if self.adaptive else self.t
+        if self.adaptive:
+            b1t = 1.0 - self.hyper["beta_1"] ** t_next
+            b2t = 1.0 - self.hyper["beta_2"] ** t_next
+        else:
+            b1t = b2t = 1.0
+        state_in = {k: tuple(v) for k, v in self.state.items()}
+        new_params, new_state, n_total, sq = self._program(
+            n_samples,
+            tuple(stacked_flat),
+            tuple(self.params),
+            state_in,
+            jnp.float32(lr),
+            jnp.float32(b1t),
+            jnp.float32(b2t),
+        )
+        from photon_tpu.utils.profiling import (
+            EFFECTIVE_LR,
+            N_CLIENTS,
+            N_SAMPLES,
+            PARAM_NORM,
+            PSEUDO_GRAD_NORM,
+        )
+
+        metrics = {
+            N_CLIENTS: float(self.n_clients),
+            N_SAMPLES: float(np.asarray(n_total)),
+            EFFECTIVE_LR: float(lr),
+            PSEUDO_GRAD_NORM: float(np.sqrt(np.asarray(sq["pseudo_grad"]))),
+            PARAM_NORM: float(np.sqrt(np.asarray(sq["param"]))),
+        }
+        for key in self.state_keys:
+            metrics[f"server/{key}_norm"] = float(np.sqrt(np.asarray(sq[key])))
+        # the scalar fetches above synchronized, so the program is known to
+        # have completed — only now commit the round. A program that fails
+        # (dispatch or at the fetch) leaves params/state/t at the previous
+        # round, keeping bias correction honest across a retry/checkpoint.
+        self.params = list(new_params)
+        self.state = {k: list(v) for k, v in new_state.items()}
+        self.t = t_next
+        return metrics
+
+    # -- host bridges ------------------------------------------------------
+    def params_host(self) -> list[np.ndarray]:
+        return [np.asarray(p) for p in self.params]
+
+    def state_host(self) -> dict[str, list[np.ndarray]]:
+        return {k: [np.asarray(a) for a in v] for k, v in self.state.items()}
+
+    def sync_strategy(self, strategy: Any) -> None:
+        """Mirror the device-resident round results back into the host
+        strategy, so ``Strategy.state_for_checkpoint`` (and the broadcast
+        path reading ``current_parameters``) see exactly what the device
+        plane computed."""
+        strategy.current_parameters = self.params_host()
+        strategy.restore_optimizer_state(self.state_host(), t=self.t)
+
+    def modeled_round_bytes(self) -> int:
+        """Modeled cross-slice DCN bytes for one round over this plane's
+        payload structure (see :func:`modeled_cross_slice_bytes`)."""
+        return modeled_cross_slice_bytes(
+            [int(np.prod(p.shape, dtype=np.int64)) for p in self.params],
+            self.n_clients,
+            replica=mesh_replica(self.mesh),
+            quantization=self.quantization,
+            block=self.block,
+        )

@@ -218,3 +218,16 @@ class Strategy:
     # checkpointing --------------------------------------------------------
     def state_for_checkpoint(self) -> dict[str, list[np.ndarray]]:
         return {k: self.state[k] for k in self.state_keys if k in self.state}
+
+    def restore_optimizer_state(
+        self, state: dict[str, list[np.ndarray]], t: int | None = None
+    ) -> None:
+        """Adopt optimizer state computed elsewhere — the device aggregation
+        plane (``parallel/collective_agg.py``) syncs its device-resident
+        momenta back through here so :meth:`state_for_checkpoint` serializes
+        exactly what the fused on-device round produced. ``t`` is the
+        adaptive strategies' step counter; the base/momentum rules ignore
+        it (see the override in ``optimizers._AdaptiveBase``)."""
+        self.state = {
+            k: [np.asarray(a, np.float32) for a in v] for k, v in state.items()
+        }

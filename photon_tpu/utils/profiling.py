@@ -66,6 +66,25 @@ GNS_SQNORM_EST = "server/gns_sqnorm_est"
 GRADIENT_NOISE_SCALE = "server/gradient_noise_scale"
 COLLECTIVE_AGG_TIME = "server/collective_agg_time"
 
+# -- device-resident aggregation plane (parallel/collective_agg.py) -------
+# Hierarchy stage decomposition of COLLECTIVE_AGG_TIME (which spans all
+# three), recorded per round by CollectiveFedRunner:
+#: host rows → client-axis-sharded device arrays (stack + device_put)
+COLLECTIVE_STACK_TIME = "server/collective_stack_time"
+#: the fused SPMD program: hierarchical reduce (+ q8 codec) + server update
+COLLECTIVE_EXCHANGE_TIME = "server/collective_exchange_time"
+#: replicated result → host (broadcast/checkpoint mirror fetch; on the
+#: host-optimizer path also the host strategy update itself)
+COLLECTIVE_UPDATE_TIME = "server/collective_update_time"
+#: modeled cross-slice DCN bytes this round (idealized once-across model,
+#: ``collective_agg.modeled_cross_slice_bytes`` — the fp32-vs-q8 ratio is
+#: the number that matters, not the absolute)
+COLLECTIVE_WIRE_BYTES = "server/collective_wire_bytes"
+#: q8 encode+decode seconds, measured OUT-OF-LINE by ``bench.py
+#: --collective`` (inside the round the codec is fused into the exchange
+#: program and cannot be timed separately)
+COLLECTIVE_QUANT_TIME = "server/collective_quant_time"
+
 # -- wire / compression plane (WireStats.metrics_since) -------------------
 WIRE_UPLINK_RAW_BYTES = "server/wire_uplink_raw_bytes"
 WIRE_UPLINK_BYTES = "server/wire_uplink_bytes"

@@ -14,6 +14,7 @@ import pathlib
 import subprocess
 import sys
 
+import jax
 import numpy as np
 import pytest
 
@@ -86,6 +87,12 @@ def _cfg(tmp_path, strategy="fedavg", momenta=False) -> Config:
 
 
 @pytest.mark.slow
+@pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="jax 0.4.37 CPU backend can't run multiprocess computations "
+    "(XLA: 'Multiprocess computations aren't implemented on the CPU "
+    "backend') — the single-controller e2es below cover the plane here",
+)
 @pytest.mark.parametrize(
     "strategy,momenta",
     [("fedavg", False), ("fedadam", True)],
@@ -158,3 +165,162 @@ def test_collective_rounds_match_driver_topology(tmp_path, strategy, momenta):
         m = json.loads(pathlib.Path(str(out) + ".metrics.json").read_text())
         assert m["eval_loss"] is not None and oracle_eval is not None
         np.testing.assert_allclose(m["eval_loss"], oracle_eval, rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: device-resident aggregation plane e2e (single-controller,
+# in-process — the multi-process parity e2e above stays the slow oracle)
+# ---------------------------------------------------------------------------
+
+
+def _plane_cfg(tmp_path, quantization, n_rounds=3):
+    cfg = _cfg(tmp_path, strategy="fedadam", momenta=False)
+    cfg.fl.n_total_clients = 2
+    cfg.fl.n_clients_per_round = 2
+    cfg.fl.n_rounds = n_rounds
+    cfg.fl.eval_interval_rounds = 0  # retrace discipline is about run_round
+    cfg.photon.comm_stack.collective_replica = 2
+    cfg.photon.comm_stack.collective_quantization = quantization
+    cfg.photon.comm_stack.collective_q8_block = 64
+    cfg.photon.comm_stack.collective_device_optimizer = True
+    cfg.photon.save_path = str(tmp_path / f"plane-{quantization}")
+    cfg.validate()
+    return cfg
+
+
+@pytest.mark.parametrize("quantization", ["off", "q8"])
+def test_collective_round_e2e_retrace_free_from_round_2(tmp_path, quantization):
+    """Acceptance: the full collective-round e2e (real ClientRuntime fits →
+    hierarchical exchange → fused device FedAdam) is compile-free from
+    round 2 under the PR 6 RetraceSentinel for both quantization policies.
+    Also pins the new per-round KPIs and the device-path param flow."""
+    from photon_tpu.analysis.runtime import (
+        install_retrace_sentinel,
+        uninstall_retrace_sentinel,
+    )
+    from photon_tpu.federation.collective_round import CollectiveFedRunner
+    from photon_tpu.parallel.collective_agg import modeled_cross_slice_bytes
+
+    cfg = _plane_cfg(tmp_path, quantization)
+    sentinel = install_retrace_sentinel()
+    try:
+        runner = CollectiveFedRunner(cfg, [0, 1])
+        assert runner.device_plane is not None
+        sentinel.mark_steady_after(1)  # round 1 = warmup (fit + program compiles)
+        for rnd in range(1, cfg.fl.n_rounds + 1):
+            metrics = runner.run_round(rnd)
+        sentinel.check("collective/e2e")
+    finally:
+        uninstall_retrace_sentinel()
+
+    # KPI surface: hierarchy stage timings + modeled DCN bytes every round
+    hist = runner.history
+    for name in (
+        "server/collective_agg_time",
+        "server/collective_stack_time",
+        "server/collective_exchange_time",
+        "server/collective_update_time",
+        "server/collective_wire_bytes",
+    ):
+        assert len(hist.series(name)) == cfg.fl.n_rounds, name
+    sizes = [int(np.prod(p.shape)) for p in runner.strategy.current_parameters]
+    expect = modeled_cross_slice_bytes(
+        sizes, 2, replica=2, quantization=quantization, block=64
+    )
+    assert metrics["server/collective_wire_bytes"] == float(expect)
+    # the device plane's params ARE the strategy's params (broadcast mirror)
+    for a, b in zip(runner.strategy.current_parameters,
+                    runner.device_plane.params_host()):
+        np.testing.assert_array_equal(a, b)
+    # adaptive bias-correction counter advanced once per round and is
+    # checkpointable through the existing host path
+    assert runner.device_plane.t == cfg.fl.n_rounds
+    assert "_t" in runner.state_for_checkpoint()
+
+
+def test_collective_round_device_path_matches_host_path(tmp_path):
+    """The fused device-optimizer path and the host-strategy path must
+    produce the same parameters for the same config (fp32 tolerance —
+    psum average is identical, only the update arithmetic moves)."""
+    from photon_tpu.federation.collective_round import CollectiveFedRunner
+
+    cfg_dev = _plane_cfg(tmp_path / "dev", "off", n_rounds=2)
+    runner_dev = CollectiveFedRunner(cfg_dev, [0, 1])
+    runner_dev.run(2)
+
+    cfg_host = _plane_cfg(tmp_path / "host", "off", n_rounds=2)
+    cfg_host.photon.comm_stack.collective_device_optimizer = False
+    cfg_host.validate()
+    runner_host = CollectiveFedRunner(cfg_host, [0, 1])
+    runner_host.run(2)
+
+    assert runner_dev.device_plane is not None
+    assert runner_host.device_plane is None
+    for a, b in zip(runner_dev.strategy.current_parameters,
+                    runner_host.strategy.current_parameters):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("device_opt", [True, False], ids=["device-opt", "host-opt"])
+def test_collective_round_q8_momenta_stays_finite(tmp_path, device_opt):
+    """Regression: q8 + aggregate_momenta went NaN at round 3 — quantization
+    noise turns the exactly-zero pseudo-gradient of idle second-moment
+    elements tiny-nonzero, the sign-like adaptive server step then kicks
+    them negative, and the next fit sqrt()s them. Both optimizer paths now
+    clamp the m2 rows >= 0 on the q8 policy (collective_round._nonneg_rows)."""
+    from photon_tpu.federation.collective_round import CollectiveFedRunner
+    from photon_tpu.train.param_ops import M2_PREFIX
+
+    cfg = _cfg(tmp_path, strategy="fedadam", momenta=True)
+    cfg.fl.n_rounds = 3  # the unclamped run NaNs exactly here
+    cfg.fl.eval_interval_rounds = 0
+    cfg.photon.comm_stack.collective_replica = 2
+    cfg.photon.comm_stack.collective_quantization = "q8"
+    cfg.photon.comm_stack.collective_device_optimizer = device_opt
+    cfg.photon.save_path = str(tmp_path / "q8-momenta")
+    cfg.validate()
+
+    runner = CollectiveFedRunner(cfg, list(range(4)))
+    assert runner._nonneg_rows  # momenta payload → m2 rows identified
+    for rnd in range(1, cfg.fl.n_rounds + 1):
+        metrics = runner.run_round(rnd)
+        assert np.isfinite(metrics["server/pseudo_grad_norm"]), rnd
+    for name, p in zip(runner.meta.names, runner.strategy.current_parameters):
+        assert np.all(np.isfinite(p)), name
+        if name.startswith(M2_PREFIX):
+            assert float(p.min()) >= 0.0, name
+
+
+def test_collective_runner_resume_via_load_server_state(tmp_path):
+    """Runner-level resume: state_for_checkpoint + control_state_for_checkpoint
+    → load_server_state keeps the fused FedAdam run bit-identical with the
+    uninterrupted run. As in the driver topology's golden resume test,
+    ``reset_optimizer`` keeps client optimizer state round-local; loader
+    positions resume via the checkpointed client-state sample counters."""
+    from photon_tpu.federation.collective_round import CollectiveFedRunner
+
+    def resume_cfg(name):
+        cfg = _plane_cfg(tmp_path / name, "off", n_rounds=3)
+        cfg.fl.fit_config = {"reset_optimizer": True}
+        return cfg
+
+    cont = CollectiveFedRunner(resume_cfg("cont"), [0, 1])
+    for rnd in range(1, 4):
+        cont.run_round(rnd)
+
+    part = CollectiveFedRunner(resume_cfg("parta"), [0, 1])
+    for rnd in range(1, 3):
+        part.run_round(rnd)
+    params = [p.copy() for p in part.strategy.current_parameters]
+    state = {k: [a.copy() for a in v] for k, v in part.state_for_checkpoint().items()}
+    control = part.control_state_for_checkpoint()
+
+    resumed = CollectiveFedRunner(resume_cfg("partb"), [0, 1])
+    resumed.load_server_state(params, state, control)
+    assert resumed.device_plane.t == 2
+    assert resumed.server_steps_cumulative == part.server_steps_cumulative
+    resumed.run_round(3)
+
+    for a, b in zip(cont.strategy.current_parameters,
+                    resumed.strategy.current_parameters):
+        np.testing.assert_array_equal(a, b)

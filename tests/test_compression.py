@@ -394,3 +394,119 @@ def test_e2e_compressed_fedavg_matches_uncompressed(tmp_path):
     assert hist.cumulative("server/wire_uplink_bytes") * 4 <= hist.cumulative(
         "server/wire_uplink_raw_bytes"
     )
+
+
+# ---------------------------------------------------------------------------
+# in-collective jnp port (ISSUE 7): single source of truth with quantize.py
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "n,block",
+    [
+        (1024, 256),   # aligned
+        (1000, 256),   # ragged final block
+        (33, 16),      # ragged, small
+        (5, 256),      # single partial block
+        (256, 256),    # exactly one block
+    ],
+    ids=["aligned", "ragged", "ragged-small", "partial", "one-block"],
+)
+def test_quantize_jnp_port_golden_parity(n, block):
+    """The jnp port used INSIDE the cross-slice collective must produce
+    byte-identical int8 codes and fp32 scales to the host codec — the
+    aggregation plane's error analysis is only valid if the two quantizers
+    ARE the same quantizer."""
+    from photon_tpu.compression.quantize_jnp import (
+        dequantize_q8_jnp,
+        quantize_q8_jnp,
+    )
+
+    rng = np.random.default_rng(n * 31 + block)
+    x = rng.normal(0, 0.7, n).astype(np.float32)
+    # exercise the all-zero-block guard (scale 0, codes 0) when possible
+    if n >= 2 * block:
+        x[block : 2 * block] = 0.0
+
+    codes_np, scales_np = quantize_q8(x, block=block)
+    codes_j, scales_j = quantize_q8_jnp(x, block=block)
+    np.testing.assert_array_equal(codes_np, np.asarray(codes_j))
+    np.testing.assert_array_equal(scales_np, np.asarray(scales_j))
+    assert np.asarray(codes_j).dtype == np.int8
+    assert np.asarray(scales_j).dtype == np.float32
+
+    back_np = dequantize_q8(codes_np, scales_np, block=block)
+    back_j = dequantize_q8_jnp(codes_j, scales_j, block=block)
+    np.testing.assert_array_equal(back_np, np.asarray(back_j))
+
+
+def test_quantize_jnp_port_all_zero_input():
+    from photon_tpu.compression.quantize_jnp import (
+        dequantize_q8_jnp,
+        quantize_q8_jnp,
+    )
+
+    x = np.zeros(100, np.float32)
+    codes_np, scales_np = quantize_q8(x, block=32)
+    codes_j, scales_j = quantize_q8_jnp(x, block=32)
+    np.testing.assert_array_equal(codes_np, np.asarray(codes_j))
+    np.testing.assert_array_equal(scales_np, np.asarray(scales_j))
+    np.testing.assert_array_equal(np.asarray(dequantize_q8_jnp(codes_j, scales_j, block=32)), x)
+
+
+def test_quantizer_constants_single_source():
+    """DEFAULT_BLOCK/_QMAX are imported by the jnp port, never redeclared."""
+    import photon_tpu.compression.quantize as qnp
+    import photon_tpu.compression.quantize_jnp as qj
+
+    assert qj.DEFAULT_BLOCK is qnp.DEFAULT_BLOCK
+    assert qj._QMAX is qnp._QMAX
+
+
+# ---------------------------------------------------------------------------
+# aligned-path micro-fix (ISSUE 7 satellite): no padded copy when
+# n % block == 0, output identical to the reference padded implementation
+# ---------------------------------------------------------------------------
+
+
+def _quantize_q8_reference(values, block):
+    """The pre-fix implementation: always pads (the oracle for the
+    aligned-fast-path regression)."""
+    flat = np.asarray(values, dtype=np.float32).reshape(-1)
+    n = flat.size
+    n_blocks = max(1, -(-n // block))
+    padded = np.zeros(n_blocks * block, dtype=np.float32)
+    padded[:n] = flat
+    grid = padded.reshape(n_blocks, block)
+    absmax = np.abs(grid).max(axis=1)
+    scales = (absmax / 127.0).astype(np.float32)
+    safe = np.where(scales > 0, scales, 1.0)[:, None]
+    codes = np.clip(np.rint(grid / safe), -127.0, 127.0).astype(np.int8)
+    return codes.reshape(-1)[:n].copy(), scales
+
+
+@pytest.mark.parametrize("n,block", [(512, 256), (256, 256), (64, 16), (1000, 256), (0, 256)],
+                         ids=["aligned-2", "aligned-1", "aligned-small", "ragged", "empty"])
+def test_quantize_q8_aligned_fast_path_identical(n, block):
+    rng = np.random.default_rng(7)
+    x = rng.normal(0, 1.0, n).astype(np.float32)
+    codes, scales = quantize_q8(x, block=block)
+    ref_codes, ref_scales = _quantize_q8_reference(x, block=block)
+    np.testing.assert_array_equal(codes, ref_codes)
+    np.testing.assert_array_equal(scales, ref_scales)
+    back = dequantize_q8(codes, scales, block=block)
+    padded = np.zeros(max(1, -(-n // block)) * block, np.float32)
+    padded[:n] = ref_codes.astype(np.float32)
+    ref_back = (padded.reshape(-1, block) * ref_scales[:, None]).reshape(-1)[:n]
+    np.testing.assert_array_equal(back, ref_back)
+
+
+def test_quantize_q8_aligned_returns_fresh_arrays():
+    """The fast path must not alias the caller's buffer (the wire encoder
+    mutates inputs downstream)."""
+    x = np.linspace(-1, 1, 512, dtype=np.float32)
+    codes, _ = quantize_q8(x, block=256)
+    assert not np.shares_memory(codes, x)
+    codes[0] += 1  # writable, independently owned
+    back = dequantize_q8(codes, np.ones(2, np.float32), block=256)
+    assert not np.shares_memory(back, codes)

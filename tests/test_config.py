@@ -48,6 +48,27 @@ def test_validation_errors():
         Config.from_dict({"nonexistent_key": 1})
 
 
+def test_collective_knobs_require_collective_stack():
+    # each collective_* knob is silently ignored by the driver topologies,
+    # so a non-default value without collective=true must fail validation
+    for knob, value in (
+        ("collective_quantization", "q8"),
+        ("collective_replica", 2),
+        ("collective_q8_block", 64),
+        ("collective_device_optimizer", True),
+    ):
+        cfg = Config()
+        assert not cfg.photon.comm_stack.collective
+        setattr(cfg.photon.comm_stack, knob, value)
+        with pytest.raises(ValueError, match="collective aggregation plane"):
+            cfg.validate()
+    cfg = Config()
+    cfg.photon.comm_stack.collective = True
+    cfg.photon.comm_stack.shm = False
+    cfg.photon.comm_stack.collective_q8_block = 64
+    cfg.validate()
+
+
 def test_json_roundtrip():
     cfg = Config()
     cfg.optimizer.betas = (0.8, 0.95)
