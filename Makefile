@@ -1,7 +1,7 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test test-all bench bench-host bench-telemetry bench-collective chaos telemetry-smoke serve-smoke lint lint-tests native clean
+.PHONY: test test-all bench bench-host bench-telemetry bench-collective chaos chaos-collective telemetry-smoke serve-smoke lint lint-tests native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
@@ -78,6 +78,17 @@ chaos: lint
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_chaos.py tests/test_membership.py tests/test_tcp_driver.py \
 		tests/test_checkpoint.py tests/test_shm.py -q -m chaos
+
+# elastic collective rounds (ISSUE 8): stage-deadline units + the
+# SIGKILL-mid-collective e2es (gang reconfiguration, quorum, host-fallback
+# degradation, crash phases inside the collective), all running under BOTH
+# dynamic detectors (lock-order recorder + retrace sentinel with absorbed
+# reconfiguration compiles). Deterministic (ChaosConfig seed + injected
+# clocks); the fast half rides tier-1 via the `chaos` marker. Lint
+# preflight like the other smoke targets.
+chaos-collective: lint
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_collective_elastic.py -q -m "slow or not slow"
 
 native: native/libphoton_native.so
 

@@ -25,7 +25,14 @@ from photon_tpu.utils.profiling import CHAOS_EVENT_PREFIX
 # resolved lazily to avoid a config<->chaos import cycle: config/schema.py
 # validates ChaosConfig fields, chaos only reads them
 
-_PHASES = ("pre-fit", "mid-fit", "pre-reply")
+# fit-handling phases (node processes) + collective-round phases (the
+# controller loop in ``federation/collective_round.py`` — ISSUE 8): a crash
+# at pre-exchange/mid-exchange/pre-update is a participant dying around the
+# gang's collective stages, the failure shape the elastic ladder absorbs
+_PHASES = (
+    "pre-fit", "mid-fit", "pre-reply",
+    "pre-exchange", "mid-exchange", "pre-update",
+)
 
 
 @dataclasses.dataclass
@@ -40,7 +47,16 @@ class TcpFaultPlan:
 
 @dataclasses.dataclass
 class StoreFaultPlan:
-    """One object-store write's fate."""
+    """One object-store access's fate (writes AND reads share the shape).
+
+    On a write: ``partial`` = the temp file lands but never renames into
+    place (torn upload); ``bitflip`` = one payload bit flips before the
+    otherwise-atomic write. On a read: ``partial`` = a short/truncated read
+    (half the bytes come back); ``bitflip`` = one bit of the returned bytes
+    flips (bad RAM / NFS page) while the object at rest stays intact. Both
+    directions must be caught by the same defense — checksums — never by a
+    silently-garbage load.
+    """
 
     delay_s: float = 0.0
     # write the temp file but never rename it into place — the torn-write /
@@ -71,8 +87,15 @@ class FaultInjector:
         # per-plan counters so tests can assert the schedule fired
         self.counts: dict[str, int] = {
             "tcp_drop": 0, "tcp_delay": 0, "tcp_duplicate": 0, "tcp_corrupt": 0,
-            "store_slow": 0, "store_partial": 0, "store_bitflip": 0, "crash": 0,
+            "store_slow": 0, "store_partial": 0, "store_bitflip": 0,
+            "store_read_slow": 0, "store_read_partial": 0,
+            "store_read_bitflip": 0, "crash": 0,
         }
+        # total CORRUPTING store faults (partial/bitflip, reads + writes)
+        # fired, bounded by cfg.store_fault_max (0 = unlimited) — "corrupt
+        # exactly N objects" scenarios without seed-hunting; delays neither
+        # consume nor are blocked by the budget
+        self._store_faults = 0
 
     def _fired(self, kind: str, **attrs) -> None:
         """Count a fired fault + structured telemetry event with trace
@@ -112,19 +135,44 @@ class FaultInjector:
         return bytes(buf)
 
     # -- object store ----------------------------------------------------
-    def store_plan(self) -> StoreFaultPlan:
+    def _store_capped(self) -> bool:
+        mx = int(getattr(self.cfg, "store_fault_max", 0))
+        return mx > 0 and self._store_faults >= mx
+
+    def _store_plan(self, prefix: str) -> StoreFaultPlan:
+        """One object-store access's fate; ``prefix`` keys the counters
+        (``store_`` for writes, ``store_read_`` for reads — same
+        probability knobs, separate fired-counter streams). The
+        ``store_fault_max`` cap gates CORRUPTING faults only
+        (partial/bitflip): a delay neither consumes the budget nor is
+        blocked by it, so "corrupt exactly N objects" stays deterministic
+        even with ``store_slow_p`` armed alongside."""
         c = self.cfg
         plan = StoreFaultPlan()
         if c.store_slow_p and self.rng.random() < c.store_slow_p:
             plan.delay_s = self.rng.uniform(0.0, c.store_slow_max_s)
-            self._fired("store_slow", delay_s=plan.delay_s)
+            self._fired(f"{prefix}slow", delay_s=plan.delay_s)
+        if self._store_capped():
+            return plan
         if c.store_partial_p and self.rng.random() < c.store_partial_p:
             plan.partial = True
-            self._fired("store_partial")
+            self._fired(f"{prefix}partial")
         elif c.store_bitflip_p and self.rng.random() < c.store_bitflip_p:
             plan.bitflip = True
-            self._fired("store_bitflip")
+            self._fired(f"{prefix}bitflip")
+        if plan.partial or plan.bitflip:
+            self._store_faults += 1
         return plan
+
+    def store_plan(self) -> StoreFaultPlan:
+        """One object-store WRITE's fate (``FileStore.put``)."""
+        return self._store_plan("store_")
+
+    def store_read_plan(self) -> StoreFaultPlan:
+        """One object-store READ's fate: same probability knobs as the
+        write side, separate counters (ISSUE 8 satellite — ``FileStore.get``
+        and ``get_to_file`` honor the plan like ``put`` does)."""
+        return self._store_plan("store_read_")
 
     # -- node crash ------------------------------------------------------
     def maybe_crash(self, phase: str, server_round: int = 0, node_id: str = "") -> None:
@@ -208,3 +256,8 @@ def validate_chaos_config(cfg) -> None:
         )
     if cfg.crash_round < 0:
         raise ValueError(f"chaos.crash_round must be >= 0, got {cfg.crash_round}")
+    if getattr(cfg, "store_fault_max", 0) < 0:
+        raise ValueError(
+            f"chaos.store_fault_max must be >= 0 (0 = unlimited), got "
+            f"{cfg.store_fault_max}"
+        )

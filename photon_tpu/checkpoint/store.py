@@ -129,7 +129,23 @@ class FileStore(ObjectStore):
             os.close(dirfd)
 
     def get(self, key: str) -> bytes:
-        return self._path(key).read_bytes()
+        data = self._path(key).read_bytes()
+        inj = chaos.active()
+        if inj is not None:
+            # reads fault like writes do (ISSUE 8 satellite): a slow read,
+            # a short/truncated read, or a bit flipped on the way back (bad
+            # RAM / flaky NFS) while the object at rest stays intact. The
+            # base-class get_to_file routes through here, so file reads are
+            # covered too. Consumers must catch all three via checksums
+            # (manifest CRCs) — never load silently-garbage bytes.
+            plan = inj.store_read_plan()
+            if plan.delay_s:
+                time.sleep(plan.delay_s)
+            if plan.partial:
+                data = data[: len(data) // 2]
+            if plan.bitflip:
+                data = inj.corrupt_bytes(data)
+        return data
 
     def exists(self, key: str) -> bool:
         return self._path(key).is_file()

@@ -236,6 +236,22 @@ class CommStackConfig:
       pseudo-gradient → server-optimizer round as ONE fused jitted SPMD
       program with optimizer state resident on device (all five
       strategies); off keeps the host-side strategy fold.
+
+    Elasticity knobs (ISSUE 8 — ``federation/collective_round.py``'s
+    straggler/degradation ladder):
+
+    - ``collective_stage_timeout_s``: absolute per-stage deadline (seconds)
+      on each collective stage (context handshake/stack, exchange, update).
+      0 disables deadlines (the original wedge-forever gang semantics). A
+      stage that misses its deadline raises into the reconfiguration
+      ladder instead of wedging the round.
+    - ``collective_quorum``: minimum surviving fraction of
+      ``fl.n_total_clients`` required to run the round over the collective;
+      below it the round degrades directly to the host-plane
+      ``aggregate_inplace`` fold over whichever deltas landed.
+    - ``collective_retry_budget``: bounded reconfiguration retries per
+      round after a missed stage deadline before degrading to the host
+      fold.
     """
 
     shm: bool = True
@@ -245,6 +261,9 @@ class CommStackConfig:
     collective_quantization: str = "off"  # off | q8
     collective_q8_block: int = 0  # 0 → compression DEFAULT_BLOCK (256)
     collective_device_optimizer: bool = False
+    collective_stage_timeout_s: float = 0.0  # 0 = no stage deadlines
+    collective_quorum: float = 0.5  # min surviving fraction for the collective
+    collective_retry_budget: int = 1  # reconfig attempts before host fallback
 
 
 @dataclass
@@ -289,12 +308,19 @@ class ChaosConfig:
     store_partial_p: float = 0.0  # temp file written, never renamed into place
     store_bitflip_p: float = 0.0  # caught by checkpoint manifest checksums
     # node crash: os._exit (SIGKILL-equivalent) at a phase of fit handling
+    # or — collective topology — of the aggregation round itself
     crash_phase: str = ""  # "" | pre-fit | mid-fit | pre-reply
+    #                      #    | pre-exchange | mid-exchange | pre-update
     crash_round: int = 0  # only when serving this server_round (0 = any)
     crash_node_id: str = ""  # only on this node id ("" = any)
     # marker-file path making the crash one-shot across respawns: the file
     # survives the killed process; a respawned node sees it and stays up
     crash_marker: str = ""
+    # cap on the CORRUPTING store faults (partial/bitflip, reads + writes)
+    # this process's injector fires; 0 = unlimited. Makes "corrupt exactly
+    # one object" scenarios deterministic without seed-hunting — slow
+    # faults neither consume nor are blocked by the cap.
+    store_fault_max: int = 0
 
 
 @dataclass
@@ -350,6 +376,10 @@ class ServeConfig:
     # friends). Keeps one giant prompt from starving in-flight decodes.
     prefill_token_budget: int = 2048
     eos_id: int = -1  # default per-request EOS (-1 = none; requests may override)
+    # graceful-drain bound (SIGTERM): /healthz flips to "draining", new
+    # /generate gets 503 + Retry-After, and in-flight slots get up to this
+    # many seconds to finish before the scheduler hard-stops
+    drain_timeout_s: float = 30.0
 
 
 @dataclass
@@ -650,6 +680,10 @@ class Config:
                 f"serve.prefill_token_budget must be >= 1, got "
                 f"{srv.prefill_token_budget}"
             )
+        if srv.drain_timeout_s <= 0:
+            raise ValueError(
+                f"serve.drain_timeout_s must be > 0, got {srv.drain_timeout_s}"
+            )
         if not 0 <= srv.port <= 65535:
             raise ValueError(f"serve.port must be in [0, 65535], got {srv.port}")
         tel = self.photon.telemetry
@@ -707,16 +741,35 @@ class Config:
                 f"comm_stack.collective_q8_block must be >= 0 (0 = codec "
                 f"default), got {cs.collective_q8_block}"
             )
+        if cs.collective_stage_timeout_s < 0:
+            raise ValueError(
+                f"comm_stack.collective_stage_timeout_s must be >= 0 "
+                f"(0 = no deadlines), got {cs.collective_stage_timeout_s}"
+            )
+        if not 0.0 < cs.collective_quorum <= 1.0:
+            raise ValueError(
+                f"comm_stack.collective_quorum must be in (0, 1], got "
+                f"{cs.collective_quorum}"
+            )
+        if cs.collective_retry_budget < 0:
+            raise ValueError(
+                f"comm_stack.collective_retry_budget must be >= 0, got "
+                f"{cs.collective_retry_budget}"
+            )
         if not cs.collective and (
             cs.collective_quantization != "off"
             or cs.collective_replica != 1
             or cs.collective_q8_block != 0
             or cs.collective_device_optimizer
+            or cs.collective_stage_timeout_s != 0.0
+            or cs.collective_quorum != 0.5
+            or cs.collective_retry_budget != 1
         ):
             raise ValueError(
                 "comm_stack.collective_{quantization,replica,q8_block,"
-                "device_optimizer} shape the collective aggregation plane — "
-                "set comm_stack.collective=true (the driver topologies "
+                "device_optimizer,stage_timeout_s,quorum,retry_budget} "
+                "shape the collective aggregation plane — set "
+                "comm_stack.collective=true (the driver topologies "
                 "would silently ignore them)"
             )
         _ = self.model.d_head

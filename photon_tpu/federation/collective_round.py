@@ -17,9 +17,48 @@ Topology: multi-controller SPMD. Every process runs THIS SAME loop over its
 local clients; there is no server process. Each controller holds a replica
 of the strategy and applies the identical deterministic update
 (``Strategy.apply_average``) to the psum'd average, so all replicas march in
-lockstep — divergence would desync the next psum, which is why client
-failures here are fatal rather than budgeted (the NCCL-gang tradeoff:
-bandwidth for elasticity; the driver topology keeps the failure budget).
+lockstep.
+
+**Elastic rounds (ISSUE 8).** The classic NCCL-gang tradeoff — bandwidth
+for elasticity — used to make client failures here fatal. The runner now
+buys the elasticity back with a straggler/degradation ladder:
+
+1. **Stage deadlines** — every collective stage (context handshake/stack,
+   exchange, update) gets an absolute deadline derived from
+   ``comm_stack.collective_stage_timeout_s`` on an injectable clock, so a
+   dead or byte-dripping participant can never wedge the round (0 keeps
+   the original wedge-forever semantics).
+2. **Gang reconfiguration** — a failed client fit or a
+   :class:`~photon_tpu.federation.membership.LivenessTracker`
+   live→suspect/dead edge drops the participant from the round's cohort;
+   the runner rebuilds the (clients, replica) mesh over the survivors,
+   re-stacks, and re-runs the fold with FedAvg weights renormalized over
+   the surviving sample counts (the weighted average divides by the
+   cohort's Σn, so renormalization is by construction). A missed stage
+   deadline fails the *attempt*: the retry runs over the then-current
+   surviving cohort — shrunk only if the liveness plane has ruled someone
+   out in the meantime, because a deadline alone cannot attribute the
+   wedge to a participant — bounded by the retry budget before degrading.
+   Cohort meshes and their programs are cached (bounded LRU), and a
+   legitimate first-time reconfiguration compile is budgeted against the
+   PR 6 retrace sentinel via ``absorb_compiles``. Reconfiguration is
+   **round-scoped**: every round starts from the full cohort again, so a
+   readmitted client is back at full strength the round after it returns
+   (it never "rejoins a torn gang").
+3. **Quorum + host fallback** — below ``comm_stack.collective_quorum``
+   (surviving fraction of ``fl.n_total_clients``), or once
+   ``collective_retry_budget`` reconfiguration attempts are exhausted, the
+   round degrades to the bit-exact host-plane ``aggregate_inplace`` fold
+   (PR 2) over whichever deltas landed — recorded as a degraded round
+   (``server/collective_degraded_rounds``), never an aborted run.
+
+Cohort agreement caveat (multi-controller): the cohort is computed from
+this controller's local observations (fit results + liveness states). All
+controllers of one gang must observe the same cohort to stay in lockstep —
+feed every controller's tracker from a shared control plane (e.g. the TCP
+driver's ping sweep). A divergent cohort wedges the exchange, which the
+stage deadline converts into a local host fallback; single-controller runs
+(one process, many local clients) are consistent by construction.
 
 Client training itself reuses ``ClientRuntime`` end to end (persistent
 Trainer, per-cid loaders, reset knobs, step injection), so data order and
@@ -36,28 +75,39 @@ Launch (one line per host/slice, mirroring the reference's multi-node flow
 
 from __future__ import annotations
 
+import threading
 import time
-from typing import Sequence
+import warnings
+from typing import Callable, Sequence
 
 import jax
 import numpy as np
 
 from photon_tpu import telemetry
-from photon_tpu.analysis.runtime import steady_point
+from photon_tpu.analysis.runtime import absorb_compiles, steady_point
+from photon_tpu.chaos import crash_point
 from photon_tpu.codec import params_to_ndarrays
 from photon_tpu.compression.quantize import DEFAULT_BLOCK
 from photon_tpu.config.schema import Config
 from photon_tpu.federation.client_runtime import ClientRuntime
+from photon_tpu.federation.membership import LIVE, LivenessTracker
 from photon_tpu.federation.messages import FitIns
 from photon_tpu.utils.profiling import (
     COLLECTIVE_AGG_TIME,
+    COLLECTIVE_DEGRADED_ROUNDS,
     COLLECTIVE_EXCHANGE_TIME,
+    COLLECTIVE_RECONFIG_TIME,
     COLLECTIVE_STACK_TIME,
+    COLLECTIVE_STRAGGLERS,
     COLLECTIVE_UPDATE_TIME,
     COLLECTIVE_WIRE_BYTES,
     EVAL_LOSS,
     EVAL_SAMPLES,
+    EVENT_COLLECTIVE_DEGRADED,
+    EVENT_COLLECTIVE_RECONFIG,
+    EVENT_COLLECTIVE_STRAGGLER,
     FIT_ROUND_TIME,
+    ROUND_FAILED,
     ROUND_TIME,
     STEPS_CUMULATIVE,
 )
@@ -66,12 +116,29 @@ from photon_tpu.metrics.history import History
 from photon_tpu.parallel.collective_agg import (
     CLIENT_AXIS,
     DeviceAggregationPlane,
+    evict_mesh_programs,
     hierarchical_weighted_average,
     make_hierarchical_mesh,
     mesh_replica,
     modeled_cross_slice_bytes,
 )
 from photon_tpu.strategy import dispatch_strategy
+
+
+class StageDeadlineError(RuntimeError):
+    """A collective stage missed its absolute deadline
+    (``comm_stack.collective_stage_timeout_s``). The stage's work may still
+    be running on its (daemon) worker thread — the wedged collective cannot
+    be cancelled from Python — but the round moves on through the
+    reconfiguration ladder instead of wedging with it."""
+
+    def __init__(self, stage: str, waited_s: float) -> None:
+        super().__init__(
+            f"collective stage {stage!r} missed its deadline "
+            f"(waited {waited_s:.3f}s)"
+        )
+        self.stage = stage
+        self.waited_s = waited_s
 
 
 def partition_cids(n_total_clients: int, num_processes: int, process_id: int) -> list[int]:
@@ -95,7 +162,14 @@ class CollectiveFedRunner:
     rows while fits run serially on chip 0 — launch one process per chip
     instead (e.g. ``--num_processes == slice chip count``)."""
 
-    def __init__(self, cfg: Config, process_cids: Sequence[int], mesh=None) -> None:
+    def __init__(
+        self,
+        cfg: Config,
+        process_cids: Sequence[int],
+        mesh=None,
+        clock: Callable[[], float] = time.monotonic,
+        liveness: LivenessTracker | None = None,
+    ) -> None:
         if not cfg.photon.comm_stack.collective:
             raise ValueError("CollectiveFedRunner requires photon.comm_stack.collective=true")
         if cfg.fl.n_clients_per_round != cfg.fl.n_total_clients:
@@ -110,6 +184,7 @@ class CollectiveFedRunner:
             )
         self.cfg = cfg
         self.process_cids = list(process_cids)
+        self._local_cids = frozenset(self.process_cids)
         if not self.process_cids:
             raise ValueError(
                 "this process owns no clients — launch with num_processes <= "
@@ -118,6 +193,36 @@ class CollectiveFedRunner:
         cs = cfg.photon.comm_stack
         self.quantization = cs.collective_quantization
         self.q8_block = cs.collective_q8_block or DEFAULT_BLOCK
+        #: injectable clock (the PR 3 backoff-test pattern): all stage
+        #: deadlines are absolute times on THIS clock, so deadline
+        #: bookkeeping is unit-testable without sleeping
+        self.clock = clock
+        self.stage_timeout_s = float(cs.collective_stage_timeout_s)
+        self.quorum = float(cs.collective_quorum)
+        self.retry_budget = int(cs.collective_retry_budget)
+        mem = cfg.photon.membership
+        #: per-client liveness state machine (pseudo node id ``client{cid}``):
+        #: fed by fit outcomes here, and — multi-controller — by whatever
+        #: shared control plane the operator wires in. A client whose state
+        #: is not LIVE is excluded from the round's cohort.
+        self.liveness = liveness if liveness is not None else LivenessTracker(
+            suspect_after_misses=mem.suspect_after_misses,
+            dead_after_misses=mem.dead_after_misses,
+            ping_timeout_s=mem.ping_timeout_s,
+            clock=clock,
+        )
+        # elasticity bookkeeping (ISSUE 8)
+        self.stragglers_total = 0
+        self.degraded_rounds_total = 0
+        self.reconfigs_total = 0
+        #: round → which aggregation path produced it ("collective" |
+        #: "collective_reconfigured" | "host_fallback" | "failed"); rides
+        #: the control-state checkpoint so resume knows each round's lineage
+        self.aggregation_paths: dict[int, str] = {}
+        self._cohort_meshes: dict[tuple[int, ...], object] = {}
+        #: deadline-abandoned stage workers that may still be running (their
+        #: XLA compile events land whenever they land — absorbed, not billed)
+        self._abandoned_workers: list[threading.Thread] = []
         self.mesh = mesh if mesh is not None else self._default_mesh()
         # inline transport: params never leave this process except via psum
         self.transport = ParamTransport("inline")
@@ -198,8 +303,13 @@ class CollectiveFedRunner:
         probe = jax.make_array_from_process_local_data(
             sharding, np.ones((len(self.process_cids), 1), np.float32), (n, 1)
         )
-        avg = hierarchical_weighted_average([probe], ones, self.mesh)
-        np.asarray(avg[0])  # block: the context exists once this returns
+        def _probe():
+            avg = hierarchical_weighted_average([probe], ones, self.mesh)
+            np.asarray(avg[0])  # block: the context exists once this returns
+
+        # the context handshake is a collective stage like any other: a
+        # controller that never shows up must not wedge construction forever
+        self._run_stage("handshake", _probe, self._stage_deadline())
 
     def _default_mesh(self):
         """Client mesh whose device order matches :func:`partition_cids`:
@@ -226,14 +336,126 @@ class CollectiveFedRunner:
             devices.extend(local[:want])
         return make_hierarchical_mesh(n_total, replica, devices)
 
+    # -- stage deadlines (ISSUE 8a) ------------------------------------
+    def _stage_deadline(self) -> float | None:
+        """Absolute deadline for ONE collective stage on the injected
+        clock, or None when deadlines are off."""
+        if self.stage_timeout_s <= 0:
+            return None
+        return self.clock() + self.stage_timeout_s
+
+    def _run_stage(self, stage: str, fn: Callable[[], object],
+                   deadline: float | None):
+        """Run one collective stage under its absolute deadline.
+
+        With a deadline armed the stage body runs on a named daemon worker
+        thread and the caller waits at most the remaining budget — an
+        XLA collective that never completes (dead peer, byte-dripping DCN
+        link) cannot be cancelled from Python, so on a miss the worker is
+        abandoned (daemon, it dies with the process) and
+        :class:`StageDeadlineError` routes the round into the
+        reconfiguration ladder. Deadline arithmetic uses the injected
+        clock; the thread join is bounded by the same remaining budget.
+        """
+        if deadline is None:
+            return fn()
+        start = self.clock()
+        if deadline - start <= 0:
+            raise StageDeadlineError(stage, 0.0)
+        result: dict[str, object] = {}
+
+        def _target() -> None:
+            try:
+                result["value"] = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised by the caller
+                result["error"] = e
+
+        th = threading.Thread(
+            target=_target, name=f"collective-{stage}", daemon=True
+        )
+        th.start()
+        # the deadline is judged on the INJECTED clock; the join itself
+        # waits real time in short slices (th.join's timeout is wall-clock,
+        # which need not be the injected clock's time base)
+        while th.is_alive():
+            remaining = deadline - self.clock()
+            if remaining <= 0:
+                # the worker may still be inside an XLA compile whose
+                # monitoring event lands at ANY later time — tracked so the
+                # round-end sentinel point can absorb it (see run_round)
+                self._abandoned_workers.append(th)
+                raise StageDeadlineError(stage, self.clock() - start)
+            th.join(timeout=min(remaining, 0.05))
+        if "error" in result:
+            raise result["error"]  # type: ignore[misc]
+        return result.get("value")
+
+    # -- cohorts (ISSUE 8b) --------------------------------------------
+    @staticmethod
+    def _client_node_id(cid: int) -> str:
+        return f"client{cid}"
+
+    #: bound on cached survivor-cohort meshes: every distinct cohort pins a
+    #: mesh AND its compiled aggregation programs (device memory), and a
+    #: churny fleet can visit many subsets over a long run. LRU: the least
+    #: recently used cohort is evicted with its programs; revisiting it
+    #: later recompiles (absorbed — partial cohorts always run under
+    #: ``absorb_compiles``).
+    MAX_COHORT_MESHES = 32
+
+    def _cohort_mesh(self, cohort: tuple[int, ...]):
+        """(clients, replica) mesh over the cohort's rows of the full mesh.
+        Meshes are cached per cohort (bounded LRU) so the aggregation
+        program caches (keyed per mesh object) hit on every later round
+        with the same survivors — only the FIRST round over a new cohort
+        compiles, and that compile is budgeted via ``absorb_compiles``."""
+        if len(cohort) == self.cfg.fl.n_total_clients:
+            return self.mesh
+        mesh = self._cohort_meshes.get(cohort)
+        if mesh is None:
+            while len(self._cohort_meshes) >= self.MAX_COHORT_MESHES:
+                old_cohort = next(iter(self._cohort_meshes))
+                evict_mesh_programs(self._cohort_meshes.pop(old_cohort))
+            devs = np.asarray(self.mesh.devices)
+            if devs.ndim == 1:
+                devs = devs[:, None]
+            sub = list(devs[list(cohort), :].reshape(-1))
+            mesh = make_hierarchical_mesh(len(cohort), mesh_replica(self.mesh), sub)
+        else:
+            del self._cohort_meshes[cohort]  # re-insert: LRU recency order
+        self._cohort_meshes[cohort] = mesh
+        return mesh
+
+    def _surviving_cohort(self, landed: dict[int, tuple[list[np.ndarray], int]]
+                          ) -> tuple[int, ...]:
+        """The GLOBAL surviving cohort as this controller observes it: every
+        cid except (a) our own clients whose fits failed (``landed`` only
+        ever holds this process's cids — another controller's clients are
+        presumed fine unless the shared liveness plane says otherwise) and
+        (b) any cid whose liveness state is not LIVE — a mid-round
+        live→suspect/dead edge excludes a client even if its fit result
+        arrived (its node may be dying under it)."""
+        out = []
+        for cid in range(self.cfg.fl.n_total_clients):
+            if cid in self._local_cids and cid not in landed:
+                continue  # we watched this client's fit fail
+            h = self.liveness.nodes.get(self._client_node_id(cid))
+            if h is None or h.state == LIVE:
+                out.append(cid)
+        return tuple(out)
+
     # ------------------------------------------------------------------
-    def _stack_local(self, rows: list[list[np.ndarray]]) -> list[jax.Array]:
+    def _stack_local(self, rows: list[list[np.ndarray]], mesh=None,
+                     n_global: int | None = None) -> list[jax.Array]:
         """Per-layer: process-local ``[n_local, ...]`` rows → global
-        ``[n_clients, ...]`` client-axis-sharded arrays."""
+        ``[n_clients, ...]`` client-axis-sharded arrays (on ``mesh``, which
+        defaults to the full-cohort mesh)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        sharding = NamedSharding(self.mesh, P(CLIENT_AXIS))
-        n_global = self.cfg.fl.n_total_clients
+        mesh = mesh if mesh is not None else self.mesh
+        sharding = NamedSharding(mesh, P(CLIENT_AXIS))
+        n_global = (n_global if n_global is not None
+                    else self.cfg.fl.n_total_clients)
         out = []
         for li in range(len(rows[0])):
             local = np.stack([r[li] for r in rows])
@@ -256,8 +478,7 @@ class CollectiveFedRunner:
         # matches the driver topology's definition: fit_round_time spans the
         # client fits AND the aggregation (server.py fit_round)
         t_fit = time.monotonic()
-        rows: list[list[np.ndarray]] = []
-        ns: list[int] = []
+        landed: dict[int, tuple[list[np.ndarray], int]] = {}
         for cid in self.process_cids:
             ins = FitIns(
                 server_round=server_round,
@@ -271,83 +492,333 @@ class CollectiveFedRunner:
                 config=dict(cfg.fl.fit_config),
             )
             res = self.runtime.fit(ins, cid)
+            nid = self._client_node_id(cid)
             if res.error:
-                # lockstep psum: a missing contribution cannot be budgeted
-                # away mid-program (see module docstring)
-                raise RuntimeError(
-                    f"collective round {server_round}: cid {cid} failed: {res.error}"
+                # elastic rounds (ISSUE 8): a failed/crashed client is a
+                # straggler dropped from THIS round's cohort, not a fatal
+                # error — reconfiguration is round-scoped, so it is
+                # re-attempted (and readmitted) next round
+                self.liveness.observe_miss(nid)
+                telemetry.emit_event(
+                    EVENT_COLLECTIVE_STRAGGLER, round=server_round, cid=cid,
+                    reason="fit_error", detail=res.error[:200],
                 )
+                warnings.warn(
+                    f"collective round {server_round}: cid {cid} failed "
+                    f"({res.error.splitlines()[0][:120]}) — dropped from the "
+                    "round's cohort",
+                    stacklevel=2,
+                )
+                continue
+            self.liveness.observe_alive(nid)
             if res.client_state:
                 self.client_states[res.cid] = res.client_state
             _, arrays = self.transport.get(res.params)
-            rows.append(arrays)
-            ns.append(res.n_samples)
+            landed[cid] = (arrays, res.n_samples)
             self.transport.free(res.params)
 
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        crash_point("pre-exchange", server_round, self.runtime.node_id)
 
         t_agg = time.monotonic()
+        metrics, path, stragglers, reconfig_s = self._aggregate_elastic(
+            server_round, landed
+        )
+        if metrics is None:
+            # nothing landed: the round is recorded failed (params and the
+            # step counter unchanged) and the run CONTINUES — never aborted
+            warnings.warn(
+                f"collective round {server_round}: no client deltas landed — "
+                "round recorded failed, parameters unchanged",
+                stacklevel=2,
+            )
+            metrics = {
+                ROUND_FAILED: 1.0,
+                COLLECTIVE_STACK_TIME: 0.0,
+                COLLECTIVE_EXCHANGE_TIME: 0.0,
+                COLLECTIVE_UPDATE_TIME: 0.0,
+                COLLECTIVE_WIRE_BYTES: 0.0,
+            }
+        else:
+            self.server_steps_cumulative += cfg.fl.local_steps
+        if self.device_plane is not None and path in (
+            "collective_reconfigured", "host_fallback"
+        ):
+            # the round ran OFF the fused plane (survivors fold / host
+            # fold applied on the host strategy): push the result back so
+            # the device-resident state re-enters lockstep for next round
+            # (absorb: the first reseed's device_puts may compile)
+            with absorb_compiles("collective/reseed"):
+                self.device_plane.reseed_from(self.strategy)
+
+        metrics[COLLECTIVE_STRAGGLERS] = float(stragglers)
+        metrics[COLLECTIVE_DEGRADED_ROUNDS] = (
+            1.0 if path == "host_fallback" else 0.0
+        )
+        metrics[COLLECTIVE_RECONFIG_TIME] = reconfig_s
+        metrics[COLLECTIVE_AGG_TIME] = time.monotonic() - t_agg
+        metrics[FIT_ROUND_TIME] = time.monotonic() - t_fit
+        metrics[STEPS_CUMULATIVE] = float(self.server_steps_cumulative)
+        metrics[ROUND_TIME] = time.monotonic() - t_round
+        self.stragglers_total += stragglers
+        if path == "host_fallback":
+            self.degraded_rounds_total += 1
+        self.aggregation_paths[server_round] = path
+        self.history.record(server_round, metrics)
+        if self._abandoned_workers:
+            # a deadline-abandoned worker may have been mid-compile when it
+            # was disowned; its compile event lands whenever the thread gets
+            # there (possibly during the host fallback, after every
+            # absorb_compiles window closed). Forgive this round's interval
+            # rather than billing a behaviorally-correct degraded round as a
+            # retrace bug; detection is back at full strength once the
+            # abandoned threads die.
+            with absorb_compiles("collective/abandoned"):
+                pass
+            self._abandoned_workers = [
+                t for t in self._abandoned_workers if t.is_alive()
+            ]
+        steady_point("collective/round")
+        return metrics
+
+    # -- the straggler/degradation ladder (ISSUE 8) --------------------
+    def _aggregate_elastic(
+        self,
+        server_round: int,
+        landed: dict[int, tuple[list[np.ndarray], int]],
+    ) -> tuple[dict[str, float] | None, str, int, float]:
+        """Aggregate over whoever survived: collective → (reconfigured)
+        collective → host fallback. Returns ``(metrics | None, path,
+        stragglers, reconfig_seconds)``; ``None`` metrics = nothing landed.
+        """
+        n_total = self.cfg.fl.n_total_clients
+        # liveness-excluded clients whose deltas DID land are stragglers too
+        for cid in sorted(set(landed) - set(self._surviving_cohort(landed))):
+            telemetry.emit_event(
+                EVENT_COLLECTIVE_STRAGGLER, round=server_round, cid=cid,
+                reason="liveness",
+            )
+        attempts = 0
+        reconfig_s = 0.0
+        degraded_reason = None
+        while True:
+            cohort = self._surviving_cohort(landed)
+            if not cohort or not any(cid in landed for cid in cohort):
+                # no local deltas at all: this controller has nothing to
+                # fold (and nothing to contribute to a gang that, by the
+                # cohort-agreement caveat, its peers will also tear down).
+                # Stragglers = clients actually missing from the cohort —
+                # peers' live clients are not miscounted on a local wipeout
+                return None, "failed", n_total - len(cohort), reconfig_s
+            if len(cohort) < self.quorum * n_total:
+                degraded_reason = (
+                    f"below quorum: {len(cohort)}/{n_total} surviving < "
+                    f"{self.quorum}"
+                )
+                break
+            if attempts > self.retry_budget:
+                degraded_reason = (
+                    f"retry budget exhausted ({self.retry_budget} reconfig "
+                    "attempts)"
+                )
+                break
+            t0 = time.monotonic()
+            # rollback point: an attempt can fail AFTER its fused run
+            # committed (exchange landed, update stage missed its deadline)
+            # — without the restore, the retry would apply the optimizer
+            # step a second time on the once-stepped state
+            snap = (self.device_plane.snapshot()
+                    if self.device_plane is not None else None)
+            try:
+                if len(cohort) < n_total:
+                    # a survivors-cohort program is a legitimate steady-state
+                    # compile the first time this cohort appears — budget it
+                    # against the retrace sentinel instead of tripping it
+                    with absorb_compiles("collective/reconfig"):
+                        metrics = self._collective_attempt(
+                            server_round, cohort, landed
+                        )
+                    path = "collective_reconfigured"
+                else:
+                    metrics = self._collective_attempt(server_round, cohort, landed)
+                    path = "collective"
+                return metrics, path, n_total - len(cohort), reconfig_s
+            except StageDeadlineError as e:
+                reason, stage = str(e), e.stage
+            except Exception as e:  # noqa: BLE001 — a torn gang surfaces as
+                # a distributed-runtime error as often as a hang; both route
+                # into the same reconfigure-or-degrade ladder (bounded by
+                # the retry budget, so a genuine bug still surfaces — as a
+                # loudly-warned degraded round with the error attached)
+                reason, stage = f"{type(e).__name__}: {e}", "exchange"
+            attempts += 1
+            reconfig_s += time.monotonic() - t0
+            self.reconfigs_total += 1
+            if self.device_plane is not None:
+                # an abandoned fused attempt may still be running on its
+                # worker thread: its late commit must not tear the plane —
+                # and whatever it DID commit rolls back to the attempt's
+                # snapshot so the retry (or the host fallback's reseed)
+                # starts from the pre-round state
+                self.device_plane.abandon()
+                self.device_plane.restore(snap)
+            telemetry.emit_event(
+                EVENT_COLLECTIVE_RECONFIG, round=server_round,
+                attempt=attempts, stage=stage, cohort=len(cohort),
+                reason=reason[:200],
+            )
+            warnings.warn(
+                f"collective round {server_round}: attempt {attempts} failed "
+                f"at stage {stage!r} ({reason.splitlines()[0][:160]}) — "
+                f"reconfiguring ({self.retry_budget - attempts + 1} retries "
+                "left before host fallback)",
+                stacklevel=2,
+            )
+        # -- degrade: the bit-exact host-plane fold over landed deltas ----
+        # reuse the cohort the loop just validated: recomputing here could
+        # diverge under a concurrently-fed liveness tracker (ping sweep on
+        # another thread) and hand the fallback an empty fold — aborting on
+        # exactly the path that exists to never abort
+        telemetry.emit_event(
+            EVENT_COLLECTIVE_DEGRADED, round=server_round,
+            cohort=len(cohort), reason=degraded_reason,
+        )
+        warnings.warn(
+            f"collective round {server_round}: degrading to the host-plane "
+            f"fold over {len(cohort)}/{n_total} clients ({degraded_reason})",
+            stacklevel=2,
+        )
+        metrics = self._host_fallback(server_round, cohort, landed)
+        return metrics, "host_fallback", n_total - len(cohort), reconfig_s
+
+    def _collective_attempt(
+        self,
+        server_round: int,
+        cohort: tuple[int, ...],
+        landed: dict[int, tuple[list[np.ndarray], int]],
+    ) -> dict[str, float]:
+        """One aggregation attempt over ``cohort``, each stage under its
+        deadline. Full cohort + device optimizer → the fused plane (exactly
+        the PR 7 program). Partial cohort → the (optionally quantized)
+        average over the survivors mesh with FedAvg weights renormalized by
+        construction (Σn runs over cohort rows only), then the host
+        strategy update — the fused plane's state re-enters via
+        ``reseed_from`` afterwards."""
+        cfg = self.cfg
+        n_total = cfg.fl.n_total_clients
+        full = len(cohort) == n_total
+        mesh = self._cohort_mesh(cohort)
+        local_cids = [cid for cid in cohort if cid in landed]
+        rows = [landed[cid][0] for cid in local_cids]
+        ns = [landed[cid][1] for cid in local_cids]
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
         with telemetry.span(COLLECTIVE_STACK_TIME):
             t_stage = time.monotonic()
-            stacked = self._stack_local(rows)
-            ns_global = jax.make_array_from_process_local_data(
-                NamedSharding(self.mesh, P(CLIENT_AXIS)),
-                np.asarray(ns, np.int32),
-                (cfg.fl.n_total_clients,),
+
+            def _stack():
+                stacked = self._stack_local(rows, mesh, len(cohort))
+                ns_global = jax.make_array_from_process_local_data(
+                    NamedSharding(mesh, P(CLIENT_AXIS)),
+                    np.asarray(ns, np.int32),
+                    (len(cohort),),
+                )
+                return stacked, ns_global
+
+            stacked, ns_global = self._run_stage(
+                "stack", _stack, self._stage_deadline()
             )
             stack_s = time.monotonic() - t_stage
 
-        if self.device_plane is not None:
+        if self.device_plane is not None and full:
             # fused path: average + pseudo-grad + server optimizer as ONE
             # jitted SPMD program, state resident on device
             with telemetry.span(COLLECTIVE_EXCHANGE_TIME):
                 t_stage = time.monotonic()
-                metrics = self.device_plane.run_round(
-                    stacked, ns_global,
-                    lr=self.strategy.effective_lr(cfg.fl.n_total_clients),
+                # epoch captured HERE (caller thread): an abandon issued
+                # while the worker is still ramping up must not be missed
+                epoch = self.device_plane.current_epoch()
+
+                def _exchange():
+                    crash_point("mid-exchange", server_round, self.runtime.node_id)
+                    return self.device_plane.run_round(
+                        stacked, ns_global,
+                        lr=self.strategy.effective_lr(n_total), epoch=epoch,
+                    )
+
+                metrics = self._run_stage(
+                    "exchange", _exchange, self._stage_deadline()
                 )
                 exchange_s = time.monotonic() - t_stage
+            crash_point("pre-update", server_round, self.runtime.node_id)
             with telemetry.span(COLLECTIVE_UPDATE_TIME):
                 t_stage = time.monotonic()
+
+                # the worker only FETCHES (the wedge-able device→host IO);
+                # the host-mirror mutation happens on the caller thread
+                # after the stage returns, so a deadline-abandoned worker
+                # can never mutate the strategy underneath a retry or the
+                # host fallback when it eventually completes
+                def _fetch():
+                    return (self.device_plane.params_host(),
+                            self.device_plane.state_host(),
+                            self.device_plane.t)
+
+                params_host, state_host, t = self._run_stage(
+                    "update", _fetch, self._stage_deadline()
+                )
                 # host mirror: the next broadcast and any checkpoint read
                 # strategy.current_parameters (replicated outputs → every
                 # controller fetches identical values)
-                self.device_plane.sync_strategy(self.strategy)
+                self.strategy.current_parameters = params_host
+                self.strategy.restore_optimizer_state(state_host, t=t)
                 self.strategy.server_round = server_round
                 update_s = time.monotonic() - t_stage
         else:
-            # host-optimizer path: the collective carries the (optionally
-            # quantized) average; the strategy replica updates on host.
-            # Σn rides the same SPMD program as one extra psum output — a
-            # separate collective per round would double the rendezvous cost
+            # host-optimizer path (and every partial-cohort attempt): the
+            # collective carries the (optionally quantized) average; the
+            # strategy replica updates on host. Σn rides the same SPMD
+            # program as one extra psum output — a separate collective per
+            # round would double the rendezvous cost
             with telemetry.span(COLLECTIVE_EXCHANGE_TIME):
                 t_stage = time.monotonic()
-                avg_dev, total_dev = hierarchical_weighted_average(
-                    stacked, ns_global, self.mesh,
-                    quantization=self.quantization, block=self.q8_block,
-                    return_total=True,
+
+                def _exchange():
+                    crash_point("mid-exchange", server_round, self.runtime.node_id)
+                    avg_dev, total_dev = hierarchical_weighted_average(
+                        stacked, ns_global, mesh,
+                        quantization=self.quantization, block=self.q8_block,
+                        return_total=True,
+                    )
+                    # wait for the collective HERE so exchange_time means
+                    # the same thing on both optimizer paths (the device
+                    # path blocks on its scalar fetches inside run_round);
+                    # the device→host payload copy belongs to the update
+                    # bucket, mirroring the device path's sync_strategy
+                    jax.block_until_ready(avg_dev)
+                    return avg_dev, total_dev
+
+                avg_dev, total_dev = self._run_stage(
+                    "exchange", _exchange, self._stage_deadline()
                 )
-                # wait for the collective HERE so exchange_time means the
-                # same thing on both optimizer paths (the device path blocks
-                # on its scalar fetches inside run_round); the device→host
-                # payload copy belongs to the update bucket, mirroring the
-                # device path's sync_strategy fetch
-                jax.block_until_ready(avg_dev)
                 exchange_s = time.monotonic() - t_stage
+            crash_point("pre-update", server_round, self.runtime.node_id)
             with telemetry.span(COLLECTIVE_UPDATE_TIME):
                 t_stage = time.monotonic()
-                avg = [np.asarray(a) for a in avg_dev]
-                n_total = int(np.asarray(total_dev))
-                metrics = self.strategy.apply_average(
-                    server_round, avg, n_total, cfg.fl.n_total_clients
+
+                # worker fetches only (see the device path above): the pure-
+                # numpy strategy update runs on the caller thread, so an
+                # abandoned fetch can never apply a stale round later
+                def _fetch():
+                    avg = [np.asarray(a) for a in avg_dev]
+                    n_samples = int(np.asarray(total_dev))
+                    return avg, n_samples
+
+                avg, n_samples = self._run_stage(
+                    "update", _fetch, self._stage_deadline()
                 )
-                if self.quantization == "q8":
-                    # same second-moment clamp as the device plane (see
-                    # __init__) — apply_average returns fresh arrays, so
-                    # in-place is safe
-                    for i in self._nonneg_rows:
-                        p = self.strategy.current_parameters[i]
-                        np.maximum(p, 0.0, out=p)
+                metrics = self._apply_average_host(
+                    server_round, avg, n_samples, len(cohort)
+                )
                 update_s = time.monotonic() - t_stage
 
         metrics[COLLECTIVE_STACK_TIME] = stack_s
@@ -356,19 +827,65 @@ class CollectiveFedRunner:
         metrics[COLLECTIVE_WIRE_BYTES] = float(
             modeled_cross_slice_bytes(
                 [int(np.prod(r.shape, dtype=np.int64)) for r in rows[0]],
-                cfg.fl.n_total_clients,
-                replica=mesh_replica(self.mesh),
+                len(cohort),
+                replica=mesh_replica(mesh),
                 quantization=self.quantization,
                 block=self.q8_block,
             )
         )
-        metrics[COLLECTIVE_AGG_TIME] = time.monotonic() - t_agg
-        metrics[FIT_ROUND_TIME] = time.monotonic() - t_fit
-        self.server_steps_cumulative += cfg.fl.local_steps
-        metrics[STEPS_CUMULATIVE] = float(self.server_steps_cumulative)
-        metrics[ROUND_TIME] = time.monotonic() - t_round
-        self.history.record(server_round, metrics)
-        steady_point("collective/round")
+        return metrics
+
+    def _apply_average_host(
+        self, server_round: int, avg: list[np.ndarray], n_samples: int,
+        n_clients: int,
+    ) -> dict[str, float]:
+        """Host half of the non-fused paths: strategy update on the
+        (collectively or host-) averaged payload, with the q8-policy
+        second-moment clamp (see ``__init__``: the invariant must hold on
+        every path of a q8 run — prior q8 rounds leave idle m2 elements
+        tiny-positive, so even an exact fold can be stepped negative)."""
+        metrics = self.strategy.apply_average(
+            server_round, avg, n_samples, n_clients
+        )
+        if self.quantization == "q8":
+            # apply_average returns fresh arrays, so in-place is safe
+            for i in self._nonneg_rows:
+                p = self.strategy.current_parameters[i]
+                np.maximum(p, 0.0, out=p)
+        return metrics
+
+    def _host_fallback(
+        self,
+        server_round: int,
+        cohort: tuple[int, ...],
+        landed: dict[int, tuple[list[np.ndarray], int]],
+    ) -> dict[str, float]:
+        """The degradation floor: the host-plane streaming fold (PR 2) over
+        whichever deltas landed — bit-exact with ``aggregate_inplace`` fed
+        the same surviving subset because it IS that fold. No collective
+        rendezvous, so a torn gang cannot wedge it; on a multi-controller
+        gang each controller folds its LOCAL survivors — the cohort also
+        names peers' clients whose deltas never land here (see the module
+        docstring's cohort-agreement caveat)."""
+        from photon_tpu.strategy.aggregation import aggregate_inplace
+
+        with telemetry.span(COLLECTIVE_EXCHANGE_TIME, degraded=True):
+            t0 = time.monotonic()
+            avg, n_samples = aggregate_inplace(
+                (landed[cid] for cid in cohort if cid in landed)
+            )
+            fold_s = time.monotonic() - t0
+        with telemetry.span(COLLECTIVE_UPDATE_TIME, degraded=True):
+            t1 = time.monotonic()
+            metrics = self._apply_average_host(
+                server_round, avg, n_samples, len(cohort)
+            )
+            update_s = time.monotonic() - t1
+        metrics[COLLECTIVE_STACK_TIME] = 0.0
+        metrics[COLLECTIVE_EXCHANGE_TIME] = fold_s
+        metrics[COLLECTIVE_UPDATE_TIME] = update_s
+        # nothing crossed a slice boundary this round
+        metrics[COLLECTIVE_WIRE_BYTES] = 0.0
         return metrics
 
     # -- checkpoint bridge --------------------------------------------------
@@ -385,10 +902,17 @@ class CollectiveFedRunner:
         """The non-tensor control snapshot a resume needs alongside the
         strategy state — same vocabulary as ``ServerApp.save_checkpoint``'s
         ``server_state`` (client sample counters drive loader fast-forward
-        after a restart)."""
+        after a restart). ``aggregation_paths`` records which aggregation
+        path produced each round ("collective" | "collective_reconfigured"
+        | "host_fallback" | "failed") so a resume — and anyone auditing the
+        manifest-checksummed checkpoint chain (PR 3) — can tell a degraded
+        round's parameters from a full-cohort collective's."""
         return {
             "server_steps_cumulative": self.server_steps_cumulative,
             "client_states": dict(self.client_states),
+            "aggregation_paths": {
+                int(r): p for r, p in self.aggregation_paths.items()
+            },
         }
 
     def load_server_state(self, parameters, state=None, control=None) -> None:
@@ -405,6 +929,10 @@ class CollectiveFedRunner:
             )
             self.client_states = {
                 int(k): v for k, v in control.get("client_states", {}).items()
+            }
+            self.aggregation_paths = {
+                int(k): str(v)
+                for k, v in control.get("aggregation_paths", {}).items()
             }
         if self.device_plane is not None:
             self.device_plane = DeviceAggregationPlane(
@@ -434,27 +962,77 @@ class CollectiveFedRunner:
                 config=dict(self.cfg.fl.eval_config),
             )
             res = self.runtime.evaluate(ins, cid)
+            nid = self._client_node_id(cid)
             if res.error:
-                raise RuntimeError(
-                    f"collective eval round {server_round}: cid {cid} failed: {res.error}"
+                # elastic eval (ISSUE 8): a failed eval client scores with
+                # ZERO weight — the full-mesh program still runs (no
+                # reconfiguration compile for an eval), and a zero-n row
+                # drops out of the weighted mean exactly
+                self.liveness.observe_miss(nid)
+                telemetry.emit_event(
+                    EVENT_COLLECTIVE_STRAGGLER, round=server_round, cid=cid,
+                    reason="eval_error", detail=res.error[:200],
                 )
+                warnings.warn(
+                    f"collective eval round {server_round}: cid {cid} failed "
+                    f"({res.error.splitlines()[0][:120]}) — scored with zero "
+                    "weight",
+                    stacklevel=2,
+                )
+                losses.append(np.asarray([0.0], np.float32))
+                ns.append(0)
+                continue
+            self.liveness.observe_alive(nid)
             losses.append(np.asarray([res.loss], np.float32))
             ns.append(res.n_samples)
-        loss_global = self._stack_local([[l] for l in losses])[0]
-        ns_global = jax.make_array_from_process_local_data(
-            NamedSharding(self.mesh, P(CLIENT_AXIS)),
-            np.asarray(ns, np.int32),
-            (self.cfg.fl.n_total_clients,),
-        )
+
         # losses are [1]-vectors — quantizing them would be all cost, no
-        # byte savings, so eval always rides the fp32 exchange
-        avg, total = hierarchical_weighted_average(
-            [loss_global], ns_global, self.mesh, return_total=True
-        )
-        metrics = {
-            EVAL_LOSS: float(np.asarray(avg[0])[0]),
-            EVAL_SAMPLES: float(np.asarray(total)),
-        }
+        # byte savings, so eval always rides the fp32 exchange. The
+        # exchange runs under the same stage deadline as a fit round's: a
+        # dead peer must not wedge the eval that follows a survived round
+        def _exchange():
+            loss_global = self._stack_local([[l] for l in losses])[0]
+            ns_global = jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, P(CLIENT_AXIS)),
+                np.asarray(ns, np.int32),
+                (self.cfg.fl.n_total_clients,),
+            )
+            avg, total = hierarchical_weighted_average(
+                [loss_global], ns_global, self.mesh, return_total=True
+            )
+            return float(np.asarray(avg[0])[0]), float(np.asarray(total))
+
+        try:
+            loss, total = self._run_stage(
+                "eval-exchange", _exchange, self._stage_deadline()
+            )
+        except Exception as e:  # noqa: BLE001 — same stance as the fit
+            # ladder: a torn gang surfaces as a hang (deadline) or a
+            # distributed-runtime error; eval has no retry budget, it falls
+            # straight back to the local weighted mean (cohort-agreement
+            # caveat: multi-controller, this is this controller's slice)
+            warnings.warn(
+                f"collective eval round {server_round}: exchange failed "
+                f"({type(e).__name__}: {e}) — falling back to the local "
+                "weighted mean",
+                stacklevel=2,
+            )
+            local_n = int(sum(ns))
+            loss = (
+                float(np.dot([float(l[0]) for l in losses], ns)) / local_n
+                if local_n else 0.0
+            )
+            total = float(local_n)
+        if total == 0:
+            warnings.warn(
+                f"collective eval round {server_round}: no eval samples "
+                "landed — eval skipped",
+                stacklevel=2,
+            )
+            metrics = {EVAL_SAMPLES: 0.0}
+            self.history.record(server_round, metrics)
+            return metrics
+        metrics = {EVAL_LOSS: loss, EVAL_SAMPLES: total}
         self.history.record(server_round, metrics)
         return metrics
 

@@ -34,6 +34,7 @@ import socket
 import struct
 import threading
 import time
+import warnings
 import zlib
 from collections import deque
 from typing import Any
@@ -487,6 +488,20 @@ def run_node(
             clean = agent.serve(conn)
         except OSError:
             clean = False  # send failed mid-reply: same as connection loss
+        except Exception as e:  # noqa: BLE001 — supervisor hardening (ISSUE 8)
+            # a crash that escapes the agent loop OUTSIDE per-message
+            # handling (reply pickling, telemetry piggyback, a collective
+            # stage a hybrid runtime drives) used to kill the supervisor
+            # outright — the node left the federation forever over one bad
+            # round. Treat it as a torn connection: log, back off, redial
+            # and re-HELLO into the NEXT round; the server dead-letters
+            # whatever it still had in flight on the old socket.
+            warnings.warn(
+                f"node {node_id}: agent loop crashed "
+                f"({type(e).__name__}: {e}) — redialing into the next round",
+                stacklevel=2,
+            )
+            clean = False
         finally:
             conn.close()
         if clean:

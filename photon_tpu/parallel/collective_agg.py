@@ -53,6 +53,7 @@ the reference's float accumulation (``aggregate_inplace``).
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Sequence
 
 import jax
@@ -247,6 +248,15 @@ def _average_program(
         prog = jax.jit(_mapped_average(mesh, n_leaves, quantization, block))
         _AVG_PROGRAMS[key] = prog
     return prog
+
+
+def evict_mesh_programs(mesh: Mesh) -> None:
+    """Drop every cached average program built over ``mesh``. Pair with
+    evicting the mesh itself (e.g. the collective runner's bounded
+    cohort-mesh cache): a jitted executable pins device memory for the
+    process lifetime otherwise."""
+    for key in [k for k in _AVG_PROGRAMS if k[0] is mesh]:
+        del _AVG_PROGRAMS[key]
 
 
 def hierarchical_weighted_average(
@@ -486,11 +496,7 @@ class DeviceAggregationPlane:
         #: from a restored strategy so resume keeps ``1 − β^t`` continuous
         self.t = int(getattr(strategy, "_t", 0))
         self._replicated = NamedSharding(mesh, P())
-        self.params: list[jax.Array] = [
-            jax.device_put(np.asarray(p, np.float32), self._replicated)
-            for p in strategy.current_parameters
-        ]
-        n_rows = len(self.params)
+        n_rows = len(strategy.current_parameters)
         if any(not 0 <= int(i) < n_rows for i in nonneg_rows):
             raise ValueError(
                 f"nonneg_rows out of range for a {n_rows}-row payload: "
@@ -505,16 +511,36 @@ class DeviceAggregationPlane:
         #: sqrt(m2) on the next fit. Clamping at `off` would break the
         #: bit-exact pins against the host oracle, which does not clamp.
         self.nonneg_rows = tuple(sorted({int(i) for i in nonneg_rows}))
-        self.state: dict[str, list[jax.Array]] = {}
+        self._seed_from_host(strategy)
+        self._program: Callable | None = None
+        # abandon-epoch (ISSUE 8): bumped when the caller gives up on an
+        # in-flight run_round (missed stage deadline); a late-completing
+        # abandoned run must not commit params/state/t under the round that
+        # replaced it. The lock makes the worker's check-and-commit atomic
+        # with abandon()/reseed_from(): an abandon can't slip between the
+        # epoch check and the last field assignment, and a reseed can't
+        # interleave with a stale commit's writes.
+        self._epoch = 0
+        self._commit_lock = threading.Lock()
+
+    def _seed_from_host(self, strategy: Any) -> None:
+        """Device-put params + optimizer state from the host strategy (the
+        single seeding point shared by ``__init__`` and
+        :meth:`reseed_from`); missing state keys seed zero-filled."""
+        self.params = [
+            jax.device_put(np.asarray(p, np.float32), self._replicated)
+            for p in strategy.current_parameters
+        ]
+        self.state = {}
         for key in self.state_keys:
             host = strategy.state.get(key)
             if host is None:
-                host = [np.zeros_like(np.asarray(p, np.float32)) for p in strategy.current_parameters]
+                host = [np.zeros_like(np.asarray(p, np.float32))
+                        for p in strategy.current_parameters]
             self.state[key] = [
                 jax.device_put(np.asarray(a, np.float32), self._replicated)
                 for a in host
             ]
-        self._program: Callable | None = None
 
     # -- the fused program -------------------------------------------------
     def _build_program(self, n_leaves: int) -> Callable:
@@ -555,14 +581,26 @@ class DeviceAggregationPlane:
 
         return jax.jit(program)
 
+    def current_epoch(self) -> int:
+        """Abandon-epoch token for ``run_round(epoch=...)``. Capture it on
+        the CALLER thread before dispatching the stage worker: if the
+        worker read the epoch itself, an :meth:`abandon` issued while the
+        worker was still ramping up would be missed (the worker would see
+        the post-bump value and its commit would pass the guard)."""
+        with self._commit_lock:
+            return self._epoch
+
     def run_round(
-        self, stacked_flat: Sequence[jax.Array], n_samples: jax.Array, lr: float
+        self, stacked_flat: Sequence[jax.Array], n_samples: jax.Array,
+        lr: float, epoch: int | None = None,
     ) -> dict[str, float]:
         """One fused server round over client-axis-sharded stacked rows.
         Updates the device-resident params/state in place and returns the
         round metrics (the same vocabulary as the host
         ``Strategy.apply_average``). Blocks until the program finishes (the
-        scalar fetches below synchronize)."""
+        scalar fetches below synchronize). ``epoch``: abandon-epoch token
+        from :meth:`current_epoch` when running on a deadline-abandonable
+        worker; defaults to the current epoch (inline callers)."""
         if len(stacked_flat) != len(self.params):
             raise ValueError(
                 f"stacked payload has {len(stacked_flat)} arrays, plane holds "
@@ -571,6 +609,8 @@ class DeviceAggregationPlane:
             )
         if self._program is None:
             self._program = self._build_program(len(self.params))
+        if epoch is None:
+            epoch = self.current_epoch()
         t_next = self.t + 1 if self.adaptive else self.t
         if self.adaptive:
             b1t = 1.0 - self.hyper["beta_1"] ** t_next
@@ -608,10 +648,45 @@ class DeviceAggregationPlane:
         # have completed — only now commit the round. A program that fails
         # (dispatch or at the fetch) leaves params/state/t at the previous
         # round, keeping bias correction honest across a retry/checkpoint.
-        self.params = list(new_params)
-        self.state = {k: list(v) for k, v in new_state.items()}
-        self.t = t_next
+        # An ABANDONED run (the caller hit a stage deadline and moved on —
+        # :meth:`abandon`) skips the commit entirely: the round it belonged
+        # to already completed another way.
+        with self._commit_lock:
+            if epoch == self._epoch:
+                self.params = list(new_params)
+                self.state = {k: list(v) for k, v in new_state.items()}
+                self.t = t_next
         return metrics
+
+    def abandon(self) -> None:
+        """Disown any in-flight :meth:`run_round` (the caller's stage
+        deadline fired and the round will complete another way): when the
+        abandoned worker eventually finishes, its commit is skipped. Blocks
+        until any commit already past its epoch check has finished its
+        writes, so a subsequent :meth:`reseed_from` can never interleave
+        with a stale commit."""
+        with self._commit_lock:
+            self._epoch += 1
+
+    def snapshot(self) -> tuple:
+        """Commit-state snapshot (cheap reference copies — device arrays
+        are immutable) taken before a collective attempt. A failed attempt
+        may have ALREADY committed its fused run (the exchange landed, then
+        the update stage missed its deadline): :meth:`restore` rolls the
+        plane back so the retry re-applies the round ONCE, not on top of
+        the half-finished attempt's step."""
+        with self._commit_lock:
+            return (list(self.params),
+                    {k: list(v) for k, v in self.state.items()}, self.t)
+
+    def restore(self, snap: tuple) -> None:
+        """Roll back to a :meth:`snapshot` (pair with :meth:`abandon`
+        first, so a straggling worker can't re-commit over the rollback)."""
+        params, state, t = snap
+        with self._commit_lock:
+            self.params = list(params)
+            self.state = {k: list(v) for k, v in state.items()}
+            self.t = t
 
     # -- host bridges ------------------------------------------------------
     def params_host(self) -> list[np.ndarray]:
@@ -627,6 +702,20 @@ class DeviceAggregationPlane:
         plane computed."""
         strategy.current_parameters = self.params_host()
         strategy.restore_optimizer_state(self.state_host(), t=self.t)
+
+    def reseed_from(self, strategy: Any) -> None:
+        """Inverse of :meth:`sync_strategy`: re-device_put params/state from
+        the host strategy after a round ran OFF the plane (gang
+        reconfiguration over a survivors cohort, or the host-fallback fold —
+        ISSUE 8). The cached fused program is kept — rebuilding the plane
+        would recompile it, which the retrace discipline forbids — and the
+        adaptive ``_t`` follows the host strategy, which incremented it when
+        it applied the off-plane update."""
+        if strategy.current_parameters is None:
+            raise RuntimeError("strategy not initialized with parameters")
+        with self._commit_lock:
+            self._seed_from_host(strategy)
+            self.t = int(getattr(strategy, "_t", self.t))
 
     def modeled_round_bytes(self) -> int:
         """Modeled cross-slice DCN bytes for one round over this plane's

@@ -100,14 +100,32 @@ def main(argv: list[str] | None = None) -> None:
         "block_size": engine.block_size,
     }), flush=True)
 
+    # SIGTERM = graceful drain (ISSUE 8 satellite): healthz flips to
+    # "draining" (the load balancer pulls us), new /generate gets 503 +
+    # Retry-After, in-flight slots finish within serve.drain_timeout_s,
+    # then the scheduler hard-stops. SIGINT (operator ^C) stays immediate.
     stop = threading.Event()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        signal.signal(sig, lambda *_: stop.set())
+    graceful = threading.Event()
+
+    def _sigterm(*_):
+        graceful.set()
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
     try:
         stop.wait()
     finally:
-        frontend.close()
-        batcher.close()
+        if graceful.is_set():
+            frontend.mark_draining()
+            batcher.drain(sc.drain_timeout_s)
+            # bounded wait for handler threads still flushing responses:
+            # the batcher finishing a generation is not the reply being on
+            # the wire yet (slow client, chunked stream tail)
+            frontend.close(handler_join_s=5.0)
+        else:
+            frontend.close()
+            batcher.close()
 
 
 if __name__ == "__main__":

@@ -29,11 +29,14 @@ from __future__ import annotations
 
 import json
 import threading
+import time
+import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
 from photon_tpu.serve.scheduler import (
     ContinuousBatcher,
+    DrainingError,
     QueueFullError,
     serve_history_kpis,
 )
@@ -56,6 +59,10 @@ class ServeFrontend:
         self.request_timeout_s = request_timeout_s
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+        #: graceful-drain flag (SIGTERM): /healthz reports "draining" (load
+        #: balancers pull the instance), new /generate gets 503 +
+        #: Retry-After, in-flight handler threads keep streaming
+        self.draining = False
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> int:
@@ -90,7 +97,7 @@ class ServeFrontend:
                 if path == "/healthz":
                     eng = fe.batcher.engine
                     self._json(200, {
-                        "status": "ok",
+                        "status": "draining" if fe.draining else "ok",
                         "round": eng.loaded_round,
                         "model": eng.mc.name,
                         "slots_free": eng.n_slots - eng.n_active,
@@ -112,9 +119,28 @@ class ServeFrontend:
                 else:
                     self._json(404, {"error": f"no route {self.path!r}"})
 
+            def _discard_body(self) -> None:
+                # HTTP/1.1 keep-alive: an early reject must still consume
+                # the request body or the connection desyncs — the peer's
+                # next request line would be parsed out of leftover bytes
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                except ValueError:
+                    n = 0
+                if n > 0:
+                    self.rfile.read(n)
+
             def do_POST(self) -> None:  # noqa: N802 — http.server API
                 if self.path.rstrip("/") != "/generate":
+                    self._discard_body()
                     self._json(404, {"error": f"no route {self.path!r}"})
+                    return
+                if fe.draining:
+                    # drain contract: reject BEFORE parsing into the batcher
+                    # so load sheds at the edge while in-flight slots finish
+                    self._discard_body()
+                    self._json(503, {"error": "server draining"},
+                               {"Retry-After": "5"})
                     return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
@@ -135,6 +161,10 @@ class ServeFrontend:
                     )
                 except QueueFullError as e:
                     self._json(429, {"error": str(e)}, {"Retry-After": "1"})
+                    return
+                except DrainingError as e:
+                    # drain started between our flag check and submit
+                    self._json(503, {"error": str(e)}, {"Retry-After": "5"})
                     return
                 except (TypeError, ValueError, RuntimeError) as e:
                     # TypeError: un-coercible field types (e.g. a list for
@@ -169,7 +199,30 @@ class ServeFrontend:
                 self._chunk((json.dumps(final) + "\n").encode())
                 self.wfile.write(b"0\r\n\r\n")
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        class _Server(ThreadingHTTPServer):
+            # handlers stay daemon so an IMMEDIATE stop (SIGINT) never hangs
+            # interpreter exit on a wedged client; the graceful-drain path
+            # instead joins them explicitly (bounded) via join_handlers —
+            # the stdlib only tracks/joins NON-daemon handler threads, so a
+            # drain that skipped this could exit mid-response-write and
+            # truncate an accepted request's reply
+            def process_request(self, request, client_address):
+                t = threading.Thread(
+                    target=self.process_request_thread,
+                    args=(request, client_address),
+                    name="photon-serve-handler", daemon=True,
+                )
+                self._handler_threads.add(t)
+                t.start()
+
+            def join_handlers(self, timeout_s: float) -> bool:
+                deadline = time.monotonic() + timeout_s
+                for t in list(self._handler_threads):
+                    t.join(timeout=max(0.0, deadline - time.monotonic()))
+                return all(not t.is_alive() for t in self._handler_threads)
+
+        self._httpd = _Server((self.host, self.port), Handler)
+        self._httpd._handler_threads = weakref.WeakSet()
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="photon-serve-http", daemon=True
@@ -177,9 +230,23 @@ class ServeFrontend:
         self._thread.start()
         return self.port
 
-    def close(self) -> None:
+    def mark_draining(self) -> None:
+        """Flip the instance to draining: /healthz answers ``draining`` and
+        new /generate gets 503 + Retry-After. In-flight handler threads are
+        untouched — pair with :meth:`ContinuousBatcher.drain` to let their
+        requests finish, then :meth:`close`."""
+        self.draining = True
+
+    def close(self, handler_join_s: float = 0.0) -> None:
+        """Stop the HTTP server. ``handler_join_s > 0`` (the graceful-drain
+        path) additionally waits, bounded, for in-flight handler threads to
+        finish writing their responses — without it the interpreter can
+        exit while a daemon handler is mid-write, truncating an ACCEPTED
+        request's reply even though the batcher finished its generation."""
         if self._httpd is not None:
             self._httpd.shutdown()
+            if handler_join_s > 0:
+                self._httpd.join_handlers(handler_join_s)
             self._httpd.server_close()
             self._httpd = None
         if self._thread is not None:

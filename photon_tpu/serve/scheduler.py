@@ -57,6 +57,11 @@ class QueueFullError(RuntimeError):
     """Admission queue at ``max_queue`` — the HTTP frontend's 429."""
 
 
+class DrainingError(RuntimeError):
+    """The batcher is draining (SIGTERM received) — new submissions are
+    refused; the HTTP frontend maps this to 503 + ``Retry-After``."""
+
+
 @dataclass
 class ServeRequest:
     """One generation request and its streaming output channel."""
@@ -123,6 +128,7 @@ class ContinuousBatcher:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._stop = False
+        self._draining = False
         self._thread: threading.Thread | None = None
         self._rid = itertools.count()
         self._tick = 0
@@ -154,6 +160,33 @@ class ContinuousBatcher:
             self._thread.join(timeout=timeout)
             self._thread = None
 
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown (ISSUE 8 satellite): stop ADMITTING new
+        requests immediately (:meth:`submit` raises :class:`DrainingError`
+        → HTTP 503), let everything already accepted — queued AND running —
+        finish, bounded by ``timeout_s`` (``photon.serve.drain_timeout_s``),
+        then stop the scheduler; anything still unfinished at the bound is
+        failed by ``_drain_on_stop`` ("server shutting down"). Returns True
+        when the drain completed with zero dropped requests."""
+        with self._work:
+            self._draining = True
+            self._work.notify_all()
+        deadline = time.monotonic() + timeout_s
+        drained = False
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._queue and not self._running:
+                    drained = True
+                    break
+            time.sleep(0.01)
+        self.close()
+        return drained
+
     # -- submission (any thread) ------------------------------------------
     def submit(self, prompt: list[int], max_new_tokens: int, *,
                temperature: float = 0.0, seed: int = 0,
@@ -179,6 +212,8 @@ class ContinuousBatcher:
         with self._work:
             if self._stop:
                 raise RuntimeError("batcher is shut down")
+            if self._draining:
+                raise DrainingError("server draining: not accepting new requests")
             if len(self._queue) >= self.max_queue:
                 self.rejected += 1
                 raise QueueFullError(

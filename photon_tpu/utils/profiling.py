@@ -85,6 +85,19 @@ COLLECTIVE_WIRE_BYTES = "server/collective_wire_bytes"
 #: program and cannot be timed separately)
 COLLECTIVE_QUANT_TIME = "server/collective_quant_time"
 
+# -- elastic collective rounds (ISSUE 8, federation/collective_round.py) --
+#: clients missing from this round's surviving cohort (failed fits +
+#: liveness-excluded); 0 every round on a fault-free run
+COLLECTIVE_STRAGGLERS = "server/collective_stragglers"
+#: 1.0 when this round degraded to the host-plane ``aggregate_inplace``
+#: fold (below quorum / retry budget exhausted), else 0.0 — the runner
+#: keeps the cumulative count on ``degraded_rounds_total``
+COLLECTIVE_DEGRADED_ROUNDS = "server/collective_degraded_rounds"
+#: seconds spent reconfiguring the gang this round (survivor-cohort mesh
+#: rebuild + re-run attempts after a missed stage deadline); 0.0 when the
+#: first attempt lands
+COLLECTIVE_RECONFIG_TIME = "server/collective_reconfig_time"
+
 # -- wire / compression plane (WireStats.metrics_since) -------------------
 WIRE_UPLINK_RAW_BYTES = "server/wire_uplink_raw_bytes"
 WIRE_UPLINK_BYTES = "server/wire_uplink_bytes"
@@ -148,6 +161,13 @@ EVENT_TCP_RECONNECT = "tcp/reconnect"
 EVENT_TCP_CORRUPT_FRAME = "tcp/corrupt_frame"
 #: SpeedMonitor resolved its bf16 peak (device_kind + basis for MFU)
 EVENT_SPEED_MONITOR_PEAK = "speed_monitor/peak"
+#: a collective participant missed a stage deadline / failed its fit and
+#: was dropped from the round's cohort (ISSUE 8)
+EVENT_COLLECTIVE_STRAGGLER = "collective/straggler"
+#: the gang was rebuilt over the surviving cohort mid-round
+EVENT_COLLECTIVE_RECONFIG = "collective/reconfig"
+#: the round degraded to the host-plane aggregate_inplace fold
+EVENT_COLLECTIVE_DEGRADED = "collective/degraded"
 #: fault-injector firings are ``chaos/<plan kind>`` (chaos/injector.py
 #: counters: tcp_drop, store_bitflip, crash, ...)
 CHAOS_EVENT_PREFIX = "chaos/"

@@ -1,0 +1,728 @@
+"""Elastic collective rounds (ISSUE 8): stage deadlines, gang
+reconfiguration, quorum + host-fallback degradation, chaos crash phases.
+
+The failure unit is the *participant row* of the (clients, replica) mesh: a
+client whose fit dies (chaos SIGKILL stand-in), a liveness live→suspect
+edge mid-round, or a wedged exchange (missed stage deadline) must drop the
+participant from THIS round's cohort and still complete the round —
+reconfigured collective when quorum holds, host-plane ``aggregate_inplace``
+fold when it doesn't — never abort the run. Reconfiguration is
+round-scoped: the dead participant is readmitted at full strength the
+round after it returns.
+
+The e2es run under BOTH PR 6 dynamic detectors (lock-order recorder +
+retrace sentinel): steady-state rounds with a stable cohort must stay
+compile-free, while a legitimate reconfiguration compile is absorbed via
+``absorb_compiles`` rather than billed as a retrace bug.
+
+Deterministic under ``ChaosConfig(seed=1234)``; the fast half rides tier-1
+via the ``chaos`` marker (``make chaos-collective`` runs the whole file).
+"""
+
+import pathlib
+import time
+
+import numpy as np
+import pytest
+
+from photon_tpu import chaos, telemetry
+from photon_tpu.config.schema import Config, TelemetryConfig
+from photon_tpu.federation.collective_round import (
+    CollectiveFedRunner,
+    StageDeadlineError,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    yield
+    chaos.uninstall()
+    telemetry.uninstall()
+
+
+def _cfg(tmp_path, strategy="fedavg", n_clients=4, quantization="off",
+         device_opt=False, momenta=False, n_rounds=3) -> Config:
+    cfg = Config()
+    cfg.model.d_model = 32
+    cfg.model.n_layers = 1
+    cfg.model.n_heads = 2
+    cfg.model.max_seq_len = 16
+    cfg.model.vocab_size = 64
+    cfg.model.attn_impl = "xla"
+    cfg.model.compute_dtype = "float32"
+    cfg.train.global_batch_size = 2
+    cfg.train.device_microbatch_size = 2
+    cfg.fl.n_total_clients = n_clients
+    cfg.fl.n_clients_per_round = n_clients
+    cfg.fl.n_rounds = n_rounds
+    cfg.fl.local_steps = 1
+    cfg.fl.eval_interval_rounds = 0
+    cfg.fl.strategy_name = strategy
+    cfg.fl.server_learning_rate = 1.0 if strategy == "fedavg" else 0.01
+    cfg.fl.aggregate_momenta = momenta
+    cfg.dataset.synthetic = True
+    cfg.photon.checkpoint = False
+    cfg.photon.comm_stack.collective = True
+    cfg.photon.comm_stack.shm = False
+    cfg.photon.comm_stack.collective_quantization = quantization
+    cfg.photon.comm_stack.collective_q8_block = 64
+    cfg.photon.comm_stack.collective_device_optimizer = device_opt
+    cfg.photon.save_path = str(tmp_path / "run")
+    cfg.run_uuid = "collective-elastic"
+    cfg.validate()
+    return cfg
+
+
+def _oracle_params(params_before, lr, landed, cohort):
+    """Survivors-only host oracle: ``aggregate_inplace`` over exactly the
+    cohort's landed deltas + the FedAvg server step — the bit-exactness
+    reference for degraded rounds."""
+    from photon_tpu.strategy.aggregation import aggregate_inplace
+    from photon_tpu.strategy.optimizers import FedAvgEff
+
+    avg, n_total = aggregate_inplace(
+        ([a.copy() for a in landed[cid][0]], landed[cid][1]) for cid in cohort
+    )
+    oracle = FedAvgEff(server_learning_rate=lr)
+    oracle.initialize([p.copy() for p in params_before])
+    oracle.apply_average(0, avg, n_total, len(cohort))
+    return oracle.current_parameters
+
+
+# ---------------------------------------------------------------------------
+# stage-deadline unit tests (injectable clock — the PR 3 backoff pattern)
+# ---------------------------------------------------------------------------
+
+
+def _bare_runner(clock=time.monotonic, timeout=0.0):
+    r = object.__new__(CollectiveFedRunner)
+    r.clock = clock
+    r.stage_timeout_s = timeout
+    r._abandoned_workers = []
+    return r
+
+
+def test_stage_deadline_derived_from_injected_clock():
+    r = _bare_runner(clock=lambda: 100.0, timeout=7.0)
+    assert r._stage_deadline() == 107.0
+    r.stage_timeout_s = 0.0
+    assert r._stage_deadline() is None  # 0 = deadlines off
+
+
+def test_expired_deadline_preempts_without_running_the_stage():
+    now = [0.0]
+    r = _bare_runner(clock=lambda: now[0], timeout=5.0)
+    deadline = r._stage_deadline()  # 5.0
+    now[0] = 6.0  # the round overran before this stage even dispatched
+    ran = []
+    with pytest.raises(StageDeadlineError) as ei:
+        r._run_stage("exchange", lambda: ran.append(1), deadline)
+    assert ei.value.stage == "exchange"
+    assert not ran  # never dispatched — a wedged gang can't be re-entered
+
+
+def test_wedged_stage_abandoned_at_the_deadline():
+    r = _bare_runner(timeout=0.3)
+    t0 = time.monotonic()
+    with pytest.raises(StageDeadlineError):
+        r._run_stage("exchange", lambda: time.sleep(5.0), r._stage_deadline())
+    waited = time.monotonic() - t0
+    assert waited < 2.0  # preempted at ~0.3s, not the wedge's 5s
+
+
+def test_stage_errors_propagate_and_no_deadline_runs_inline():
+    r = _bare_runner(timeout=0.5)
+    with pytest.raises(ValueError, match="boom"):
+        r._run_stage("update", lambda: (_ for _ in ()).throw(ValueError("boom")),
+                     r._stage_deadline())
+    r.stage_timeout_s = 0.0
+    assert r._run_stage("stack", lambda: 42, r._stage_deadline()) == 42
+
+
+def test_surviving_cohort_is_global_across_controllers():
+    """Multi-controller semantics: ``landed`` only ever holds THIS
+    process's cids, so peers' clients MUST stay in the cohort (they
+    contribute their own psum rows) — a healthy multi-process round is a
+    FULL cohort, never a 'reconfigured' one. Only a local fit failure or a
+    shared-liveness exclusion removes a cid."""
+    from photon_tpu.federation.membership import LivenessTracker
+
+    r = object.__new__(CollectiveFedRunner)
+    cfg = Config()
+    cfg.fl.n_total_clients = 4
+    r.cfg = cfg
+    r._local_cids = frozenset([0, 1])  # controller 0 of 2
+    r.liveness = LivenessTracker()
+
+    row = ([np.zeros(2, np.float32)], 1)
+    # both local fits landed → the cohort is the FULL four clients
+    assert r._surviving_cohort({0: row, 1: row}) == (0, 1, 2, 3)
+    # local cid 1 failed its fit → dropped; the peers' cids 2/3 stay
+    assert r._surviving_cohort({0: row}) == (0, 2, 3)
+    # the shared liveness plane rules out a PEER's client too
+    for _ in range(2):
+        r.liveness.observe_miss("client3")
+    assert r._surviving_cohort({0: row, 1: row}) == (0, 1, 2)
+    # single-controller (the tested-everywhere shape): landed covers all
+    r._local_cids = frozenset([0, 1, 2, 3])
+    assert r._surviving_cohort({0: row, 2: row}) == (0, 2)
+
+
+# ---------------------------------------------------------------------------
+# gang reconfiguration: client dies → survivors round → readmission, under
+# both dynamic detectors (fused device plane + retrace absorb)
+# ---------------------------------------------------------------------------
+
+
+class SimKill(BaseException):
+    """In-process stand-in for os._exit(137): a BaseException the elastic
+    ladder must NOT absorb — the participant is gone, not retryable."""
+
+
+def test_fit_crash_reconfigures_then_readmits_full_strength(tmp_path):
+    """A client SIGKILLed in its round-2 fit (chaos mid-fit, one-shot via
+    crash marker) drops from the cohort; the round completes over the
+    survivors with the fused plane reseeded; round 3 runs the FULL cohort
+    again on the cached program (round-scoped reconfiguration — the
+    readmitted client never rejoins a torn gang). Compile-free from round 2
+    except the absorbed reconfiguration compiles."""
+    from photon_tpu.analysis import runtime as lint_rt
+
+    cfg = _cfg(tmp_path, strategy="fedadam", n_clients=3, device_opt=True)
+    cfg.photon.chaos.enabled = True
+    cfg.photon.chaos.crash_phase = "mid-fit"
+    cfg.photon.chaos.crash_round = 2
+    cfg.photon.chaos.crash_marker = str(tmp_path / "crash.marker")
+    cfg.validate()
+
+    def _client_crash(code):
+        raise RuntimeError(f"simulated SIGKILL ({code})")
+
+    recorder = lint_rt.install_lock_order()
+    sentinel = lint_rt.install_retrace_sentinel()
+    try:
+        chaos.install(cfg.photon.chaos, scope="collective0",
+                      crash_fn=_client_crash)
+        runner = CollectiveFedRunner(cfg, [0, 1, 2])
+        assert runner.device_plane is not None
+        sentinel.mark_steady_after(1)  # round 1 = warmup compiles
+        with pytest.warns(UserWarning, match="dropped from the round's cohort"):
+            m1 = runner.run_round(1)  # marker not armed for round 1
+            m2 = runner.run_round(2)
+        m3 = runner.run_round(3)
+        sentinel.check("collective/elastic-e2e")
+        recorder.check()
+    finally:
+        lint_rt.uninstall_retrace_sentinel()
+        lint_rt.uninstall_lock_order()
+
+    # round 1 + 3: full cohort, clean; round 2: one straggler, reconfigured
+    assert m1["server/collective_stragglers"] == 0.0
+    assert m1["server/collective_degraded_rounds"] == 0.0
+    assert m2["server/collective_stragglers"] == 1.0
+    assert m2["server/collective_degraded_rounds"] == 0.0
+    assert m2["server/n_clients"] == 2.0
+    assert m3["server/collective_stragglers"] == 0.0
+    assert m3["server/n_clients"] == 3.0  # readmitted, full strength
+    assert runner.aggregation_paths == {
+        1: "collective", 2: "collective_reconfigured", 3: "collective",
+    }
+    # the survivors-cohort program compile was absorbed, not billed
+    assert any(lbl == "collective/reconfig" for lbl, _ in sentinel.absorbed)
+    # adaptive bias correction stayed continuous across the off-plane round
+    assert runner.device_plane.t == 3
+    for p in runner.strategy.current_parameters:
+        assert np.all(np.isfinite(p))
+    # liveness: the crashed client went suspect, then back live on rejoin
+    h = runner.liveness.nodes["client0"]
+    assert h.state == "live" and h.misses == 0
+    # per-round history series exist for every new KPI
+    for name in ("server/collective_stragglers",
+                 "server/collective_degraded_rounds",
+                 "server/collective_reconfig_time"):
+        assert len(runner.history.series(name)) == 3, name
+
+
+# ---------------------------------------------------------------------------
+# the acceptance e2e: SIGKILL one client mid-round + wedged exchange → the
+# round completes within its stage deadlines via the host fold over the
+# survivors (bit-exact with the survivors-only oracle), dead client back at
+# round N+1, fault-free rounds report zero stragglers / zero degraded
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("quantization", ["off", "q8"])
+def test_sigkill_mid_collective_degrades_bitexact_and_readmits(
+    tmp_path, quantization, monkeypatch
+):
+    import photon_tpu.federation.collective_round as cr
+
+    cfg = _cfg(tmp_path, strategy="fedavg", n_clients=4,
+               quantization=quantization)
+    cfg.photon.comm_stack.collective_retry_budget = 0  # deadline → degrade
+    cfg.photon.chaos.enabled = True
+    cfg.photon.chaos.crash_phase = "mid-fit"
+    cfg.photon.chaos.crash_round = 2
+    cfg.photon.chaos.crash_marker = str(tmp_path / "crash.marker")
+    cfg.validate()
+
+    events_path = tmp_path / "events.jsonl"
+    telemetry.install(TelemetryConfig(enabled=True), scope="server",
+                      events_path=str(events_path))
+
+    def _client_crash(code):
+        raise RuntimeError(f"simulated SIGKILL ({code})")
+
+    inj = chaos.install(cfg.photon.chaos, scope="collective0",
+                        crash_fn=_client_crash)
+    runner = CollectiveFedRunner(cfg, [0, 1, 2, 3])
+
+    # after the client death the torn gang's exchange WEDGES (the real
+    # multi-controller failure shape): round 2's collective never returns,
+    # and only the stage deadline can preempt it
+    real_fold = cr.hierarchical_weighted_average
+    state = {"wedge_round": 2, "round": 0}
+
+    def wedging_fold(*args, **kwargs):
+        if state["round"] == state["wedge_round"]:
+            time.sleep(5.0)  # far past the stage deadline
+        return real_fold(*args, **kwargs)
+
+    monkeypatch.setattr(cr, "hierarchical_weighted_average", wedging_fold)
+
+    state["round"] = 1
+    m1 = runner.run_round(1)
+    runner.stage_timeout_s = 0.5  # arm deadlines AFTER warmup compiles
+
+    params_before = [p.copy() for p in runner.strategy.current_parameters]
+    landed_spy = {}
+    real_fallback = CollectiveFedRunner._host_fallback
+
+    def spy_fallback(self, server_round, cohort, landed):
+        landed_spy["cohort"] = cohort
+        landed_spy["landed"] = {
+            cid: ([a.copy() for a in arrs], n) for cid, (arrs, n) in landed.items()
+        }
+        return real_fallback(self, server_round, cohort, landed)
+
+    monkeypatch.setattr(CollectiveFedRunner, "_host_fallback", spy_fallback)
+
+    state["round"] = 2
+    t0 = time.monotonic()
+    with pytest.warns(UserWarning, match="degrading to the host-plane fold"):
+        m2 = runner.run_round(2)
+    round2_wall = time.monotonic() - t0
+    params_after_degraded = [
+        p.copy() for p in runner.strategy.current_parameters
+    ]
+    state["round"] = 3
+    m3 = runner.run_round(3)
+
+    # the dead client + wedge did not stall the round to the wedge's 5s:
+    # the stage deadline (0.5s) preempted it
+    assert round2_wall < 4.0
+    assert inj.counts["crash"] == 1  # exactly one SIGKILL (marker one-shot)
+
+    # round 2: one straggler, degraded to the host fold over the survivors
+    assert m2["server/collective_stragglers"] == 1.0
+    assert m2["server/collective_degraded_rounds"] == 1.0
+    assert m2["server/collective_reconfig_time"] > 0.0
+    assert m2["server/n_clients"] == 3.0
+    assert m2["server/collective_wire_bytes"] == 0.0  # nothing crossed DCN
+    assert runner.aggregation_paths[2] == "host_fallback"
+    assert runner.degraded_rounds_total == 1
+
+    # the degraded round's params BIT-EXACT with the survivors-only host
+    # oracle — at `off` AND at `q8` (the degradation floor is the host
+    # plane; it never quantizes, whatever the round's configured policy)
+    cohort = landed_spy["cohort"]
+    assert len(cohort) == 3 and 0 not in cohort  # cid 0 crashed first
+    oracle = _oracle_params(params_before, 1.0, landed_spy["landed"], cohort)
+    for got, want in zip(params_after_degraded, oracle):
+        np.testing.assert_array_equal(got, want)
+
+    # fault-free rounds (1 and 3) report zero stragglers, zero degraded;
+    # round 3 has the dead client back at full strength
+    for m in (m1, m3):
+        assert m["server/collective_stragglers"] == 0.0
+        assert m["server/collective_degraded_rounds"] == 0.0
+    assert m3["server/n_clients"] == 4.0
+    assert runner.aggregation_paths[3] == "collective"
+
+    # the checkpointed control state records each round's aggregation path,
+    # and a resumed runner restores it
+    control = runner.control_state_for_checkpoint()
+    assert control["aggregation_paths"] == {
+        1: "collective", 2: "host_fallback", 3: "collective",
+    }
+    resumed = CollectiveFedRunner(
+        _cfg(tmp_path / "resumed", strategy="fedavg", n_clients=4,
+             quantization=quantization), [0, 1, 2, 3],
+    )
+    resumed.load_server_state(
+        runner.strategy.current_parameters,
+        runner.state_for_checkpoint(), control,
+    )
+    assert resumed.aggregation_paths[2] == "host_fallback"
+    assert resumed.server_steps_cumulative == runner.server_steps_cumulative
+
+    # structured events with the registry vocabulary landed in the JSONL
+    telemetry.uninstall()
+    kinds = [e["kind"] for e in telemetry.read_events_jsonl(str(events_path))]
+    assert "collective/straggler" in kinds
+    assert "collective/reconfig" in kinds
+    assert "collective/degraded" in kinds
+    assert any(k.startswith("chaos/") for k in kinds)
+
+
+# ---------------------------------------------------------------------------
+# liveness edges + partial-participation parity (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_liveness_edge_mid_round_excludes_client_with_parity(tmp_path, monkeypatch):
+    """A live→suspect edge observed mid-round (after the fits, before the
+    exchange — e.g. a shared control plane's ping sweep) excludes the
+    client even though its delta landed; the survivors-only collective
+    round matches the host oracle fed the same subset, and the client is
+    back the next round once it answers again."""
+    cfg = _cfg(tmp_path, strategy="fedavg", n_clients=4)
+    runner = CollectiveFedRunner(cfg, [0, 1, 2, 3])
+    runner.run_round(1)
+
+    params_before = [p.copy() for p in runner.strategy.current_parameters]
+    landed_spy = {}
+    real_agg = CollectiveFedRunner._aggregate_elastic
+    fired = []
+
+    def edge_then_agg(self, server_round, landed):
+        if not fired:
+            fired.append(1)
+            self.liveness.observe_miss(self._client_node_id(2))
+            landed_spy.update({
+                cid: ([a.copy() for a in arrs], n)
+                for cid, (arrs, n) in landed.items()
+            })
+        return real_agg(self, server_round, landed)
+
+    monkeypatch.setattr(CollectiveFedRunner, "_aggregate_elastic", edge_then_agg)
+
+    m2 = runner.run_round(2)
+    assert m2["server/collective_stragglers"] == 1.0
+    assert m2["server/collective_degraded_rounds"] == 0.0
+    assert m2["server/n_clients"] == 3.0
+    assert runner.aggregation_paths[2] == "collective_reconfigured"
+
+    # parity: the reconfigured round == the survivors-only oracle (the
+    # collective's fp32 psum vs the oracle's fp64 streaming fold — fp32
+    # reduction-order tolerance, same pin as the full-cohort parity tests)
+    oracle = _oracle_params(params_before, 1.0, landed_spy, (0, 1, 3))
+    for got, want in zip(runner.strategy.current_parameters, oracle):
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    # the suspect client answered round 3's fit → live again, full cohort
+    m3 = runner.run_round(3)
+    assert m3["server/collective_stragglers"] == 0.0
+    assert m3["server/n_clients"] == 4.0
+    assert runner.liveness.nodes["client2"].state == "live"
+
+
+def test_survivors_only_fold_parity_off_and_q8_bound():
+    """The satellite's numeric pin, at the fold level: a survivors-subset
+    hierarchical average (the exact program a reconfigured round runs)
+    matches ``aggregate_inplace`` on the same subset at ``off``, and stays
+    within the documented per-element blockwise bound at ``q8``."""
+    import jax.numpy as jnp
+
+    from photon_tpu.parallel.collective_agg import (
+        hierarchical_weighted_average,
+        make_hierarchical_mesh,
+        stack_for_clients,
+    )
+    from photon_tpu.strategy.aggregation import aggregate_inplace
+    from tests.test_collective_agg import _client_params, _expected_q8_bound
+
+    block = 16
+    clients = [_client_params(90 + i) for i in range(4)]
+    counts = np.asarray([5, 11, 2, 31], np.int32)
+    survivors = [0, 2, 3]  # client 1 died this round
+    surv_clients = [clients[i] for i in survivors]
+    surv_counts = counts[survivors]
+
+    mesh = make_hierarchical_mesh(len(survivors), 1)
+    stacked = stack_for_clients(surv_clients, mesh)
+    off = hierarchical_weighted_average(
+        stacked, jnp.asarray(surv_counts), mesh
+    )
+    host_avg, host_total = aggregate_inplace(
+        ([c["w"], c["b"]], int(n)) for c, n in zip(surv_clients, surv_counts)
+    )
+    assert host_total == int(surv_counts.sum())  # weights renormalized
+    np.testing.assert_allclose(np.asarray(off["w"]), host_avg[0], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(off["b"]), host_avg[1], rtol=1e-5, atol=1e-6)
+
+    q8 = hierarchical_weighted_average(
+        stacked, jnp.asarray(surv_counts), mesh, quantization="q8", block=block
+    )
+    for key in ("w", "b"):
+        bound = _expected_q8_bound(surv_clients, surv_counts, key, mesh, block)
+        err = np.abs(np.asarray(q8[key], np.float64) - np.asarray(off[key], np.float64))
+        assert np.all(err <= bound + 1e-7), key
+
+
+# ---------------------------------------------------------------------------
+# chaos crash phases inside the collective: deterministic, one-shot, and a
+# respawned controller resumes the NEXT round (never rejoins the torn gang)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("phase", ["pre-exchange", "mid-exchange", "pre-update"])
+def test_collective_crash_phase_kills_controller_then_respawn_resumes(
+    tmp_path, phase
+):
+    cfg = _cfg(tmp_path, strategy="fedavg", n_clients=2)
+    cfg.photon.chaos.enabled = True
+    cfg.photon.chaos.crash_phase = phase
+    cfg.photon.chaos.crash_round = 2
+    cfg.photon.chaos.crash_marker = str(tmp_path / "crash.marker")
+    cfg.validate()
+
+    def _exit(code):
+        raise SimKill(code)
+
+    inj = chaos.install(cfg.photon.chaos, scope="collective0", crash_fn=_exit)
+    runner = CollectiveFedRunner(cfg, [0, 1])
+    runner.run_round(1)
+    params_r1 = [p.copy() for p in runner.strategy.current_parameters]
+    state_r1 = runner.state_for_checkpoint()
+    control_r1 = runner.control_state_for_checkpoint()
+
+    # SIGKILL-equivalent inside the collective: a BaseException the elastic
+    # ladder must NOT swallow — the controller process is gone
+    with pytest.raises(SimKill):
+        runner.run_round(2)
+    assert inj.counts["crash"] == 1
+    assert pathlib.Path(cfg.photon.chaos.crash_marker).exists()
+
+    # the respawned controller (same config; the marker disarms the crash)
+    # re-seeds from the last checkpoint and runs round 2 from scratch — it
+    # never tries to re-enter the torn round's half-finished collective
+    respawn = CollectiveFedRunner(cfg, [0, 1])
+    respawn.load_server_state(params_r1, state_r1, control_r1)
+    m2 = respawn.run_round(2)
+    assert m2["server/collective_stragglers"] == 0.0
+    assert inj.counts["crash"] == 1  # marker held: exactly once
+    assert respawn.aggregation_paths[2] == "collective"
+
+
+# ---------------------------------------------------------------------------
+# quorum + zero-landed floors
+# ---------------------------------------------------------------------------
+
+
+def test_below_quorum_degrades_directly_bitexact(tmp_path, monkeypatch):
+    """Two of four clients dead → 0.5 < quorum 0.75: no collective attempt,
+    straight to the host fold, bit-exact with the survivors-only oracle."""
+    cfg = _cfg(tmp_path, strategy="fedavg", n_clients=4)
+    cfg.photon.comm_stack.collective_quorum = 0.75
+    cfg.validate()
+    runner = CollectiveFedRunner(cfg, [0, 1, 2, 3])
+    runner.run_round(1)
+
+    real_fit = runner.runtime.fit
+
+    def failing_fit(ins, cid):
+        if ins.server_round == 2 and cid in (1, 2):
+            from photon_tpu.federation.messages import FitRes
+
+            return FitRes(server_round=ins.server_round, cid=cid, params=None,
+                          error="simulated node loss")
+        return real_fit(ins, cid)
+
+    monkeypatch.setattr(runner.runtime, "fit", failing_fit)
+
+    params_before = [p.copy() for p in runner.strategy.current_parameters]
+    landed_spy = {}
+    real_fallback = CollectiveFedRunner._host_fallback
+
+    def spy_fallback(self, server_round, cohort, landed):
+        landed_spy["cohort"] = cohort
+        landed_spy["landed"] = {
+            cid: ([a.copy() for a in arrs], n) for cid, (arrs, n) in landed.items()
+        }
+        return real_fallback(self, server_round, cohort, landed)
+
+    monkeypatch.setattr(CollectiveFedRunner, "_host_fallback", spy_fallback)
+
+    with pytest.warns(UserWarning, match="below quorum"):
+        m2 = runner.run_round(2)
+    assert m2["server/collective_stragglers"] == 2.0
+    assert m2["server/collective_degraded_rounds"] == 1.0
+    assert m2["server/collective_reconfig_time"] == 0.0  # no failed attempts
+    assert landed_spy["cohort"] == (0, 3)
+    oracle = _oracle_params(params_before, 1.0, landed_spy["landed"], (0, 3))
+    for got, want in zip(runner.strategy.current_parameters, oracle):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_zero_landed_round_recorded_failed_never_aborts(tmp_path, monkeypatch):
+    cfg = _cfg(tmp_path, strategy="fedavg", n_clients=2)
+    runner = CollectiveFedRunner(cfg, [0, 1])
+    runner.run_round(1)
+    params_before = [p.copy() for p in runner.strategy.current_parameters]
+    steps_before = runner.server_steps_cumulative
+
+    real_fit = runner.runtime.fit
+
+    def all_fail(ins, cid):
+        if ins.server_round == 2:
+            from photon_tpu.federation.messages import FitRes
+
+            return FitRes(server_round=ins.server_round, cid=cid, params=None,
+                          error="simulated node loss")
+        return real_fit(ins, cid)
+
+    monkeypatch.setattr(runner.runtime, "fit", all_fail)
+    with pytest.warns(UserWarning, match="no client deltas landed"):
+        m2 = runner.run_round(2)
+    assert m2["server/round_failed"] == 1.0
+    assert m2["server/collective_stragglers"] == 2.0
+    assert runner.aggregation_paths[2] == "failed"
+    # parameters and the cumulative step counter are untouched
+    for got, want in zip(runner.strategy.current_parameters, params_before):
+        np.testing.assert_array_equal(got, want)
+    assert runner.server_steps_cumulative == steps_before
+    # ... and the run continues
+    m3 = runner.run_round(3)
+    assert m3["server/collective_stragglers"] == 0.0
+    assert m3["server/n_clients"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# eval elasticity: a failed eval scores zero weight; a wedged eval exchange
+# falls back to the local weighted mean (never aborts the surviving run)
+# ---------------------------------------------------------------------------
+
+
+def test_eval_survives_client_failure_and_wedged_exchange(tmp_path, monkeypatch):
+    import photon_tpu.federation.collective_round as cr
+    from photon_tpu.federation.messages import EvaluateRes
+
+    cfg = _cfg(tmp_path, strategy="fedavg", n_clients=2)
+    runner = CollectiveFedRunner(cfg, [0, 1])
+    runner.run_round(1)
+    e0 = runner.evaluate_round(1)  # clean baseline
+    assert e0["server/eval_samples"] > 0
+
+    # one client's eval fails: zero-weight row, the weighted mean is
+    # exactly the surviving client's loss
+    real_eval = runner.runtime.evaluate
+
+    def failing_eval(ins, cid):
+        if cid == 0:
+            return EvaluateRes(server_round=ins.server_round, cid=cid,
+                               error="simulated eval node loss")
+        return real_eval(ins, cid)
+
+    monkeypatch.setattr(runner.runtime, "evaluate", failing_eval)
+    with pytest.warns(UserWarning, match="scored with zero weight"):
+        e1 = runner.evaluate_round(1)
+    assert 0 < e1["server/eval_samples"] < e0["server/eval_samples"]
+    assert np.isfinite(e1["server/eval_loss"])
+
+    # the eval exchange wedges: the stage deadline preempts it and the
+    # metric degrades to the local weighted mean instead of wedging/raising
+    runner.stage_timeout_s = 0.4
+    real_fold = cr.hierarchical_weighted_average
+
+    def wedging_fold(*args, **kwargs):
+        time.sleep(3.0)
+        return real_fold(*args, **kwargs)
+
+    monkeypatch.setattr(cr, "hierarchical_weighted_average", wedging_fold)
+    with pytest.warns(UserWarning, match="local weighted mean"):
+        e2 = runner.evaluate_round(1)
+    assert e2["server/eval_samples"] == e1["server/eval_samples"]
+    assert e2["server/eval_loss"] == pytest.approx(e1["server/eval_loss"], rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# retry-budget ladder: transient wedge → reconfig retry → clean completion
+# ---------------------------------------------------------------------------
+
+
+def test_update_stage_wedge_never_double_applies(tmp_path, monkeypatch):
+    """A fused attempt can fail AFTER its device commit (exchange lands,
+    the update-stage fetch misses its deadline). The retry must re-apply
+    the round ONCE — the plane rolls back to the attempt snapshot — and an
+    abandoned fetch worker must never mutate the strategy later. Pinned
+    against an identical unwedged runner: same params, t advanced once."""
+    cfg = _cfg(tmp_path, strategy="fedadam", n_clients=2, device_opt=True)
+    ref = CollectiveFedRunner(cfg, [0, 1])
+    ref.run_round(1)
+    ref.run_round(2)
+
+    runner = CollectiveFedRunner(cfg, [0, 1])
+    runner.run_round(1)
+    assert runner.device_plane.t == 1
+
+    real_fetch = runner.device_plane.params_host
+    wedges = []
+
+    def wedge_once():
+        if not wedges:
+            wedges.append(1)
+            time.sleep(3.0)  # past the stage deadline: fetch looks dead
+        return real_fetch()
+
+    monkeypatch.setattr(runner.device_plane, "params_host", wedge_once)
+    runner.stage_timeout_s = 0.4  # arm AFTER warmup compiles
+    with pytest.warns(UserWarning, match="reconfiguring"):
+        m2 = runner.run_round(2)
+
+    # the committed first attempt was rolled back before the retry:
+    # the optimizer stepped exactly once, params match the clean run
+    assert runner.device_plane.t == 2
+    assert m2["server/collective_degraded_rounds"] == 0.0
+    assert m2["server/collective_stragglers"] == 0.0
+    assert runner.aggregation_paths[2] == "collective"
+    for got, want in zip(runner.strategy.current_parameters,
+                         ref.strategy.current_parameters):
+        np.testing.assert_array_equal(got, want)
+    # let the abandoned fetch worker finish: it must not have touched the
+    # strategy (the caller thread owns the host-mirror mutation)
+    time.sleep(3.2)
+    for got, want in zip(runner.strategy.current_parameters,
+                         ref.strategy.current_parameters):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_transient_wedge_retries_within_budget(tmp_path, monkeypatch):
+    import photon_tpu.federation.collective_round as cr
+
+    cfg = _cfg(tmp_path, strategy="fedavg", n_clients=2)
+    assert cfg.photon.comm_stack.collective_retry_budget == 1
+    runner = CollectiveFedRunner(cfg, [0, 1])
+    runner.run_round(1)
+    runner.stage_timeout_s = 0.4  # arm AFTER warmup compiles
+
+    real_fold = cr.hierarchical_weighted_average
+    wedges = []
+
+    def wedge_once(*args, **kwargs):
+        if not wedges:
+            wedges.append(1)
+            time.sleep(3.0)  # transient stall, first attempt only
+        return real_fold(*args, **kwargs)
+
+    monkeypatch.setattr(cr, "hierarchical_weighted_average", wedge_once)
+    with pytest.warns(UserWarning, match="reconfiguring"):
+        m2 = runner.run_round(2)
+    # second attempt landed on the full cohort: collective, not degraded
+    assert m2["server/collective_degraded_rounds"] == 0.0
+    assert m2["server/collective_stragglers"] == 0.0
+    assert m2["server/collective_reconfig_time"] > 0.0
+    assert runner.aggregation_paths[2] == "collective"
+    assert runner.reconfigs_total == 1

@@ -452,6 +452,83 @@ def test_request_spans_emitted(http_server):
         assert by_name[child]["trace_id"] == umbrella["trace_id"]
 
 
+def test_graceful_drain_zero_dropped_inflight(tmp_path):
+    """SIGTERM drain contract (ISSUE 8 satellite): once draining, /healthz
+    reports ``draining`` and new /generate gets 503 + Retry-After, while
+    everything already accepted — running slots AND queued requests — runs
+    to completion within ``serve.drain_timeout_s``. Zero dropped in-flight
+    requests across the drain, outputs identical to the offline oracle."""
+    import threading
+    import time
+
+    from photon_tpu.models.mpt import init_params
+    from photon_tpu.serve.engine import PagedEngine
+    from photon_tpu.serve.frontend import ServeFrontend
+    from photon_tpu.serve.scheduler import ContinuousBatcher
+
+    cfg = _serve_cfg(n_slots=2, block_size=4, max_seq=32, max_new=8)
+    params = init_params(cfg.model, seed=4)
+    engine = PagedEngine(cfg, params)
+    batcher = ContinuousBatcher(engine, max_queue=8).start()
+    fe = ServeFrontend(batcher, max_new_tokens_cap=8)
+    port = fe.start()
+    try:
+        # warm the jit caches so in-flight timing is about scheduling
+        batcher.submit([5, 9, 2], 3).result(timeout=120)
+
+        # 4 in-flight requests: 2 fill the slots, 2 wait in the queue —
+        # the queued ones are "accepted" too and must NOT be dropped
+        prompts = [[3, 1, 4, 1, 5], [9, 2, 6], [5, 3, 5, 8], [7, 9, 3, 2]]
+        results: list[tuple[int, dict]] = [None] * len(prompts)  # type: ignore[list-item]
+
+        def _post(i: int) -> None:
+            c = _http(port)
+            c.request("POST", "/generate",
+                      json.dumps({"tokens": prompts[i], "max_new_tokens": 8}))
+            r = c.getresponse()
+            results[i] = (r.status, json.loads(r.read()))
+
+        threads = [threading.Thread(target=_post, args=(i,),
+                                    name=f"drain-client-{i}", daemon=True)
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and engine.n_active == 0:
+            time.sleep(0.005)
+        assert engine.n_active > 0  # requests genuinely in flight
+
+        # the __main__ SIGTERM sequence: flag the edge, then drain the plane
+        fe.mark_draining()
+        c = _http(port)
+        c.request("GET", "/healthz")
+        h = c.getresponse()
+        assert json.loads(h.read())["status"] == "draining"
+        c.request("POST", "/generate",
+                  json.dumps({"tokens": [1, 2, 3], "max_new_tokens": 2}))
+        r = c.getresponse()
+        refused = json.loads(r.read())
+        assert r.status == 503, refused
+        assert r.getheader("Retry-After") is not None
+
+        assert batcher.drain(cfg.photon.serve.drain_timeout_s) is True
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        # zero dropped: every accepted request completed, bit-identical
+        # with the offline oracle
+        for p, (status, body) in zip(prompts, results):
+            assert status == 200, body
+            assert body["tokens"] == _offline_greedy(cfg, params, p, 8), p
+        _assert_drained(engine, batcher)
+        # post-drain: direct submission refuses cleanly too
+        with pytest.raises(Exception):
+            batcher.submit([1, 2], 2)
+    finally:
+        fe.close()
+        batcher.close()
+
+
 def test_serve_kpis_are_registered(http_server):
     """Every KPI the batcher records is a registry constant (the serving
     half of the ISSUE 4 registry contract)."""

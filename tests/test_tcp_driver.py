@@ -283,6 +283,63 @@ def test_run_node_supervisor_redials_with_backoff(tmp_path):
     assert not t.is_alive()
 
 
+@pytest.mark.chaos
+def test_run_node_redials_after_agent_loop_crash(tmp_path, monkeypatch):
+    """ISSUE 8 satellite: a crash that ESCAPES the agent loop — a torn
+    collective stage a hybrid runtime drives, reply-path pickling, anything
+    that isn't per-message-handled — used to kill the supervisor outright,
+    removing the node from the federation forever. It must instead be
+    treated as a torn connection: back off once, redial, re-HELLO into the
+    next round, and participate full-strength (never re-enter the torn
+    gang's half-finished round)."""
+    from photon_tpu.federation.tcp import run_node
+
+    cfg = make_cfg(tmp_path, n_rounds=1, n_total_clients=1,
+                   n_clients_per_round=1, local_steps=1)
+    driver = TcpServerDriver("127.0.0.1", 0, expected_nodes=1)
+
+    real_serve = NodeAgent.serve
+    crashes = []
+
+    def crash_once(self, conn):
+        if not crashes:
+            crashes.append(1)
+            raise RuntimeError("simulated crash inside a collective stage")
+        return real_serve(self, conn)
+
+    monkeypatch.setattr(NodeAgent, "serve", crash_once)
+    delays: list[float] = []
+    t = threading.Thread(
+        target=run_node,
+        args=(f"127.0.0.1:{driver.port}", "n0", cfg.to_json()),
+        kwargs={"sleep": delays.append},
+        daemon=True,
+    )
+    t.start()
+    # connection 1: HELLO lands, then the loop crashes (non-OSError). The
+    # supervisor must come back with reconnects=1 — not exit the thread.
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if driver.hello_stats().get("n0", {}).get("reconnects") == 1:
+            break
+        time.sleep(0.05)
+    assert driver.hello_stats()["n0"]["reconnects"] == 1
+    assert crashes == [1]
+    assert len(delays) == 1  # exactly one backoff between crash and redial
+
+    # the readmitted node serves the NEXT round full-strength: a whole fed
+    # round runs over the re-dialed socket
+    app = ServerApp(cfg, driver, ParamTransport("inline"))
+    try:
+        history = app.run()
+        assert history.latest("server/n_clients") == 1.0
+        assert history.latest("server/round_failed") in (None, 0.0)
+    finally:
+        driver.shutdown()
+    t.join(timeout=15)
+    assert not t.is_alive()
+
+
 def test_tcp_dead_node_synthesizes_failure():
     driver = TcpServerDriver("127.0.0.1", 0, expected_nodes=1)
     # raw fake node that registers then vanishes mid-request
