@@ -1,7 +1,7 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test test-all bench bench-host bench-telemetry bench-collective chaos chaos-collective telemetry-smoke serve-smoke lint lint-tests native clean
+.PHONY: test test-all bench bench-host bench-telemetry bench-collective bench-compare chaos chaos-collective telemetry-smoke serve-smoke lint lint-tests native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
@@ -32,6 +32,15 @@ bench-telemetry:
 # asserts the >=3.5x modeled cross-slice byte reduction at q8
 bench-collective:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --collective
+
+# bench regression gate (ISSUE 10): diff two BENCH_r*.json artifacts'
+# shared report keys; exit nonzero on a >15% regression in train
+# tokens/sec or serving throughput. Usage:
+#   make bench-compare A=BENCH_r04.json B=BENCH_r05.json
+A ?= $(shell ls BENCH_r*.json 2>/dev/null | tail -2 | head -1)
+B ?= $(shell ls BENCH_r*.json 2>/dev/null | tail -1)
+bench-compare:
+	PALLAS_AXON_POOL_IPS= python bench.py --compare $(A) $(B)
 
 # telemetry smoke (ISSUE 4): the whole tracing/event/registry suite — the
 # fast half (in-process 1-round run → merged Perfetto trace parses with

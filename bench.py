@@ -1456,6 +1456,16 @@ def telemetry_overhead_report(n_rounds: int = 12, spin_calls: int = 200_000) -> 
         for _ in range(spin_calls):
             telemetry.emit_event("bench/noop")
         disabled_event_ns = (time.perf_counter() - t0) / spin_calls * 1e9
+        # the typed-metric hook (ISSUE 10): same one-None-check contract
+        t0 = time.perf_counter()
+        for _ in range(spin_calls):
+            telemetry.metric_observe("bench/noop", 0.0)
+        disabled_metric_ns = (time.perf_counter() - t0) / spin_calls * 1e9
+        # the profiling unit-boundary hook (server round loop / serve tick)
+        t0 = time.perf_counter()
+        for _ in range(spin_calls):
+            telemetry.profile_tick("bench/noop")
+        disabled_profile_tick_ns = (time.perf_counter() - t0) / spin_calls * 1e9
 
         # ABBA mode order: balanced against linear drift (page cache growth,
         # allocator warm-up, background compile-cache writes) — measured on
@@ -1489,6 +1499,8 @@ def telemetry_overhead_report(n_rounds: int = 12, spin_calls: int = 200_000) -> 
             "noise_pct": round(noise_pct, 2) if noise_pct is not None else None,
             "disabled_span_ns": round(disabled_span_ns, 1),
             "disabled_event_ns": round(disabled_event_ns, 1),
+            "disabled_metric_ns": round(disabled_metric_ns, 1),
+            "disabled_profile_tick_ns": round(disabled_profile_tick_ns, 1),
         }
     except Exception as e:  # noqa: BLE001 — never cost the round its numbers
         log(f"telemetry overhead report failed: {type(e).__name__}: {e}")
@@ -1761,6 +1773,136 @@ def collective_report(n_clients: int = 4, replica: int = 2,
     except Exception as e:  # noqa: BLE001 — never cost the round its numbers
         log(f"collective report failed: {type(e).__name__}: {e}")
         return None
+
+
+# ---------------------------------------------------------------------------
+# Bench regression harness (ISSUE 10 satellite): BENCH_r*.json as a GATE
+# ---------------------------------------------------------------------------
+
+def _dig(d: dict, path: tuple) -> float | None:
+    cur = d
+    for k in path:
+        if not isinstance(cur, dict) or k not in cur:
+            return None
+        cur = cur[k]
+    return float(cur) if isinstance(cur, (int, float)) and not isinstance(cur, bool) else None
+
+
+def _serving_tps(parsed: dict) -> float | None:
+    """Continuous-batching tokens/s at the report's max concurrency."""
+    conc = parsed.get("serving", {}).get("concurrency")
+    if not isinstance(conc, dict) or not conc:
+        return None
+    try:
+        k = max(conc, key=lambda s: int(s))
+    except ValueError:
+        return None
+    return _dig(conc, (k, "continuous", "tokens_per_s"))
+
+
+#: gated headline numbers, (extractor, label, platform_sensitive). Higher
+#: is better for both; a drop past the threshold exits nonzero.
+_COMPARE_GATES = (
+    (lambda p: _dig(p, ("value",)), "train_tokens_per_sec", True),
+    (_serving_tps, "serving_tokens_per_s", False),
+)
+
+
+def _numeric_leaves(d: dict, prefix: str = "") -> dict[str, float]:
+    out: dict[str, float] = {}
+    for k, v in d.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_numeric_leaves(v, key))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[key] = float(v)
+    return out
+
+
+def compare_reports(old_path: str, new_path: str,
+                    threshold: float = 0.15) -> tuple[dict, bool]:
+    """Diff two BENCH_r*.json artifacts' shared report keys; gate the
+    headline throughputs (train tokens/sec, serving continuous tokens/s at
+    max concurrency) at ``threshold`` relative regression.
+
+    The BENCH trajectory finally becomes a GATE instead of an archive:
+    ``bench.py --compare BENCH_rA.json BENCH_rB.json`` exits nonzero when
+    the new artifact regressed a gated number by more than 15%. A gate is
+    SKIPPED (reported, not judged) when either side lacks the key or the
+    two runs aren't comparable (different platform / degraded fallback —
+    a TPU number vs a CPU-smoke number is noise, not a regression)."""
+    reports = []
+    for p in (old_path, new_path):
+        with open(p) as fh:
+            d = json.load(fh)
+        reports.append(d.get("parsed", d))
+    old, new = reports
+    out: dict = {
+        "old": old_path, "new": new_path,
+        "threshold_pct": round(threshold * 100, 1),
+        "gates": {}, "regressions": [],
+    }
+    comparable_platform = (
+        old.get("platform") == new.get("platform")
+        and bool(old.get("degraded")) == bool(new.get("degraded"))
+    )
+    for extract, label, platform_sensitive in _COMPARE_GATES:
+        a, b = extract(old), extract(new)
+        gate: dict = {"old": a, "new": b}
+        if a is None or b is None:
+            gate["skipped"] = "missing on one side"
+        elif platform_sensitive and not comparable_platform:
+            gate["skipped"] = (
+                f"platforms not comparable "
+                f"({old.get('platform')}/{'degraded' if old.get('degraded') else 'full'}"
+                f" vs {new.get('platform')}/{'degraded' if new.get('degraded') else 'full'})"
+            )
+        elif a > 0:
+            delta = (b - a) / a
+            gate["delta_pct"] = round(delta * 100, 2)
+            gate["regressed"] = delta < -threshold
+            if gate["regressed"]:
+                out["regressions"].append(label)
+        else:
+            # a degenerate old value can't anchor a relative gate — report
+            # it as un-judgeable, never as a silent pass
+            gate["skipped"] = f"old value {a} is non-positive"
+        out["gates"][label] = gate
+    # the informational diff: every numeric leaf both parsed reports share
+    ol, nl = _numeric_leaves(old), _numeric_leaves(new)
+    diff = {}
+    for k in sorted(set(ol) & set(nl)):
+        a, b = ol[k], nl[k]
+        entry = {"old": a, "new": b}
+        if a:
+            entry["delta_pct"] = round((b - a) / abs(a) * 100, 2)
+        diff[k] = entry
+    out["shared_keys"] = len(diff)
+    out["diff"] = diff
+    out["ok"] = not out["regressions"]
+    return out, out["ok"]
+
+
+def compare_main(old_path: str, new_path: str) -> int:
+    try:
+        report, ok = compare_reports(old_path, new_path)
+    except (OSError, json.JSONDecodeError) as e:
+        log(f"compare: cannot read reports: {type(e).__name__}: {e}")
+        return 2
+    emit({"bench_compare": report})
+    for label, gate in report["gates"].items():
+        if "skipped" in gate:
+            log(f"compare: {label}: SKIPPED ({gate['skipped']})")
+        else:
+            log(f"compare: {label}: {gate['old']} -> {gate['new']} "
+                f"({gate.get('delta_pct', 0):+.2f}%)"
+                + (" REGRESSED" if gate.get("regressed") else ""))
+    if not ok:
+        log(f"compare: FAIL — regression(s) past "
+            f"{report['threshold_pct']}%: {report['regressions']}")
+        return 1
+    log("compare: OK — no gated regression")
+    return 0
 
 
 def collective_subprocess_report(timeout: int = 900) -> dict | None:
@@ -2284,7 +2426,13 @@ def main() -> int:
                          ">= 3.5x")
     ap.add_argument("--stage", choices=["parity", "conv", "gauntlet", "1b"],
                     help="run ONE parity/evidence stage in-process (own relay claim)")
+    ap.add_argument("--compare", nargs=2, metavar=("OLD", "NEW"),
+                    help="diff two BENCH_r*.json artifacts' shared report "
+                         "keys; exit nonzero on a >15%% regression in train "
+                         "tokens/sec or serving throughput")
     args = ap.parse_args()
+    if args.compare:
+        return compare_main(args.compare[0], args.compare[1])
     if args.host_plane:
         # pure host work — pin jax to CPU so the report runs on a dead relay
         os.environ.setdefault("JAX_PLATFORMS", "cpu")

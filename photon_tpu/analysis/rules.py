@@ -80,14 +80,14 @@ _NAME_SITES = frozenset({"span", "add_span", "timed_add", "emit_event", "emit"})
 
 
 def _name_arg_finding(ctx: FileContext, call: ast.Call, arg: ast.expr,
-                      site: str) -> Finding | None:
+                      site: str, family: str = "kpi-registry") -> Finding | None:
     reg = ctx.registry
     if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
         value = arg.value
         const = reg.values.get(value)
         if const is not None:
             return ctx.finding(
-                "kpi-registry/stringly-name", arg,
+                f"{family}/stringly-name", arg,
                 f"string literal {value!r} at {site} site: use "
                 f"profiling.{const} so the registry stays the single source "
                 "of truth",
@@ -95,14 +95,14 @@ def _name_arg_finding(ctx: FileContext, call: ast.Call, arg: ast.expr,
         if reg.is_registered(value):
             return None  # dynamic-pattern literal (rare, allowed)
         return ctx.finding(
-            "kpi-registry/unregistered-name", arg,
+            f"{family}/unregistered-name", arg,
             f"name {value!r} at {site} site is not exported by "
             "utils/profiling.py — add a registry constant (typo'd/dead names "
             "are invisible to the runtime registry test)",
         )
     if isinstance(arg, ast.JoinedStr):
         return ctx.finding(
-            "kpi-registry/fstring-name", arg,
+            f"{family}/fstring-name", arg,
             f"f-string name at {site} site: build dynamic names from a "
             "registry prefix constant (PREFIX + suffix), not a literal",
         )
@@ -110,7 +110,7 @@ def _name_arg_finding(ctx: FileContext, call: ast.Call, arg: ast.expr,
         left = arg.left
         if isinstance(left, ast.Constant) and isinstance(left.value, str):
             return ctx.finding(
-                "kpi-registry/fstring-name", arg,
+                f"{family}/fstring-name", arg,
                 f"literal-prefixed concatenation at {site} site: the prefix "
                 "must be a registry constant",
             )
@@ -141,10 +141,46 @@ def check_kpi_registry(ctx: FileContext) -> Iterator[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# 1b. metric-discipline (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+#: call sites whose first positional argument names a typed instrument or
+#: an alert kind (telemetry/metrics.py hub accessors, the telemetry.metric_*
+#: hook helpers, HealthMonitor.alert). Same static-parse approach as
+#: kpi-registry: names must be constants from utils/profiling.py, so a
+#: typo'd instrument can't silently fork a Prometheus series and an alert
+#: kind consumers filter on can't drift.
+_METRIC_SITES = frozenset({
+    "counter", "gauge", "histogram",
+    "metric_inc", "metric_set", "metric_observe",
+    "alert",
+})
+
+
+@rule("metric-discipline",
+      "instrument/alert names at metrics-plane call sites must be registry constants")
+def check_metric_discipline(ctx: FileContext) -> Iterator[Finding]:
+    if ctx.relpath.endswith("utils/profiling.py"):
+        return  # the registry itself defines the vocabulary
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _terminal_name(node.func)
+        if fname in _METRIC_SITES and node.args:
+            f = _name_arg_finding(ctx, node, node.args[0], fname,
+                                  family="metric-discipline")
+            if f is not None:
+                yield f
+
+
+# ---------------------------------------------------------------------------
 # 2. hook-gating
 # ---------------------------------------------------------------------------
 
-_ACTIVE_FNS = frozenset({"active", "events_active", "lock_order_active", "retrace_active"})
+_ACTIVE_FNS = frozenset({
+    "active", "events_active", "lock_order_active", "retrace_active",
+    "metrics_active", "health_active", "profiler_active",
+})
 
 
 def _is_active_call(node: ast.AST) -> bool:

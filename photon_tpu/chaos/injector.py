@@ -89,7 +89,7 @@ class FaultInjector:
             "tcp_drop": 0, "tcp_delay": 0, "tcp_duplicate": 0, "tcp_corrupt": 0,
             "store_slow": 0, "store_partial": 0, "store_bitflip": 0,
             "store_read_slow": 0, "store_read_partial": 0,
-            "store_read_bitflip": 0, "crash": 0,
+            "store_read_bitflip": 0, "crash": 0, "nan_delta": 0,
         }
         # total CORRUPTING store faults (partial/bitflip, reads + writes)
         # fired, bounded by cfg.store_fault_max (0 = unlimited) — "corrupt
@@ -174,6 +174,22 @@ class FaultInjector:
         and ``get_to_file`` honor the plan like ``put`` does)."""
         return self._store_plan("store_read_")
 
+    # -- numeric poison (ISSUE 10) ---------------------------------------
+    def nan_delta_plan(self, server_round: int, cid: int) -> bool:
+        """True when this client's fit delta should be NaN-poisoned as it
+        is packaged (``nan_delta_round`` matches, and ``nan_delta_cid`` is
+        -1 or this cid). Deterministic — no probability draw: the health
+        sentinel e2e needs the poison at exactly one round."""
+        c = self.cfg
+        r = int(getattr(c, "nan_delta_round", 0))
+        if not r or server_round != r:
+            return False
+        want = int(getattr(c, "nan_delta_cid", -1))
+        if want >= 0 and cid != want:
+            return False
+        self._fired("nan_delta", server_round=server_round, cid=cid)
+        return True
+
     # -- node crash ------------------------------------------------------
     def maybe_crash(self, phase: str, server_round: int = 0, node_id: str = "") -> None:
         c = self.cfg
@@ -256,6 +272,11 @@ def validate_chaos_config(cfg) -> None:
         )
     if cfg.crash_round < 0:
         raise ValueError(f"chaos.crash_round must be >= 0, got {cfg.crash_round}")
+    if getattr(cfg, "nan_delta_round", 0) < 0:
+        raise ValueError(
+            f"chaos.nan_delta_round must be >= 0 (0 = off), got "
+            f"{cfg.nan_delta_round}"
+        )
     if getattr(cfg, "store_fault_max", 0) < 0:
         raise ValueError(
             f"chaos.store_fault_max must be >= 0 (0 = unlimited), got "

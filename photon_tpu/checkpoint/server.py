@@ -278,6 +278,15 @@ class ServerCheckpointManager:
                     "skipping it for resume",
                     stacklevel=2,
                 )
+                # health plane (ISSUE 10): a corrupt round the resume path
+                # survived is still a storage incident /statusz must show
+                from photon_tpu import telemetry
+
+                health = telemetry.health_active()
+                if health is not None:
+                    health.note_store_corruption(
+                        round=r, run_uuid=self.run_uuid, stage="resume",
+                    )
                 continue
             seen_ok += 1
             if seen_ok == want:

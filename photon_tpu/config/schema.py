@@ -321,6 +321,11 @@ class ChaosConfig:
     # one object" scenarios deterministic without seed-hunting — slow
     # faults neither consume nor are blocked by the cap.
     store_fault_max: int = 0
+    # numeric-poison fault (ISSUE 10): inject a NaN into a client's fit
+    # delta as it is packaged, at exactly this server round (0 = off) —
+    # the deterministic trigger for the health plane's NaN sentinel e2e.
+    nan_delta_round: int = 0
+    nan_delta_cid: int = -1  # -1 = every client serving that round
 
 
 @dataclass
@@ -341,6 +346,14 @@ class TelemetryConfig:
     dir: str = ""  # "" → {photon.save_path}/telemetry
     prom_port: int = 0  # 0 = no /metrics endpoint
     max_buffered_spans: int = 4096  # per-process cap; overflow drops oldest
+    # run-health observatory (ISSUE 10):
+    #: capture a jax.profiler trace covering the FIRST N rounds of the run
+    #: (0 = off; the same controller also serves on-demand POST
+    #: /debug/profile requests). Artifacts land beside trace-{run}.json.
+    profile_rounds: int = 0
+    #: per-instrument ring-buffer samples the typed-metric hub retains (the
+    #: time-series view health watchers compute percentiles over)
+    metrics_retention: int = 512
 
 
 @dataclass
@@ -696,6 +709,22 @@ class Config:
             raise ValueError(
                 f"telemetry.max_buffered_spans must be >= 1, got "
                 f"{tel.max_buffered_spans}"
+            )
+        if tel.profile_rounds < 0:
+            raise ValueError(
+                f"telemetry.profile_rounds must be >= 0 (0 = off), got "
+                f"{tel.profile_rounds}"
+            )
+        if tel.metrics_retention < 1:
+            raise ValueError(
+                f"telemetry.metrics_retention must be >= 1, got "
+                f"{tel.metrics_retention}"
+            )
+        if tel.profile_rounds and not tel.enabled:
+            warnings.warn(
+                "telemetry.profile_rounds is set but telemetry.enabled=False "
+                "— no profile will be captured",
+                stacklevel=2,
             )
         from photon_tpu.chaos.injector import validate_chaos_config
 

@@ -33,7 +33,7 @@ def _stable_seed(*parts) -> int:
     process, which would desync spawned node agents)."""
     return zlib.crc32("/".join(str(p) for p in parts).encode()) & 0x7FFFFFFF
 
-from photon_tpu import telemetry
+from photon_tpu import chaos, telemetry
 from photon_tpu.checkpoint.client import ClientCheckpointManager
 from photon_tpu.codec import ParamsMetadata
 from photon_tpu.config.schema import Config
@@ -317,6 +317,15 @@ class ClientRuntime:
         t_start: float,
     ) -> FitRes:
         wall = time.monotonic() - t_start
+        inj = chaos.active()
+        if inj is not None and inj.nan_delta_plan(ins.server_round, cid):
+            # chaos numeric poison (ISSUE 10): one NaN element in the
+            # client's outgoing delta — the trainer's own arrays are never
+            # mutated, only the copy that ships. Downstream, the aggregate
+            # norm goes NaN and the health sentinel must flip /statusz.
+            poisoned = np.array(arrays[0], copy=True)
+            poisoned.reshape(-1)[:1] = np.nan
+            arrays = [poisoned, *arrays[1:]]
         # uplink payloads go through the wire codec when one is configured
         # (delta against this round's broadcast, EF residuals keyed by cid);
         # the encode span covers codec + plane write — the upload leg of the

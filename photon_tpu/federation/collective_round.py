@@ -107,6 +107,9 @@ from photon_tpu.utils.profiling import (
     EVENT_COLLECTIVE_RECONFIG,
     EVENT_COLLECTIVE_STRAGGLER,
     FIT_ROUND_TIME,
+    HBM_BYTES_IN_USE,
+    HBM_PEAK_BYTES,
+    COMPILES_TOTAL,
     ROUND_FAILED,
     ROUND_TIME,
     STEPS_CUMULATIVE,
@@ -563,6 +566,7 @@ class CollectiveFedRunner:
         if path == "host_fallback":
             self.degraded_rounds_total += 1
         self.aggregation_paths[server_round] = path
+        self._observe_collective_health(server_round, metrics, path, stragglers)
         self.history.record(server_round, metrics)
         if self._abandoned_workers:
             # a deadline-abandoned worker may have been mid-compile when it
@@ -579,6 +583,45 @@ class CollectiveFedRunner:
             ]
         steady_point("collective/round")
         return metrics
+
+    def _observe_collective_health(self, server_round: int, metrics: dict,
+                                   path: str, stragglers: int) -> None:
+        """Run-health observatory hooks at the collective round boundary
+        (ISSUE 10): stage timings into typed histograms, modeled wire bytes
+        into a counter, HBM/compile sampling, then the health watchers —
+        the NaN sentinel on the aggregate and the straggler-percentile /
+        degraded-budget watchers over the PR 8 ladder. One None check per
+        plane when telemetry is off."""
+        hub = telemetry.metrics_active()
+        if hub is not None:
+            from photon_tpu.telemetry.introspect import sample_device_plane
+
+            for key in (COLLECTIVE_STACK_TIME, COLLECTIVE_EXCHANGE_TIME,
+                        COLLECTIVE_UPDATE_TIME, COLLECTIVE_AGG_TIME,
+                        ROUND_TIME):
+                v = metrics.get(key)
+                if v is not None:
+                    hub.histogram(key).observe(float(v))
+            wire = metrics.get(COLLECTIVE_WIRE_BYTES)
+            if wire:
+                hub.counter(COLLECTIVE_WIRE_BYTES).inc(float(wire))
+            sample_device_plane(
+                metrics, hub, hbm_key=HBM_BYTES_IN_USE,
+                peak_key=HBM_PEAK_BYTES, compiles_key=COMPILES_TOTAL,
+            )
+        health = telemetry.health_active()
+        if health is not None:
+            health.check_round_metrics(server_round, metrics)
+            health.check_collective_round(
+                server_round,
+                stragglers=stragglers,
+                n_total=self.cfg.fl.n_total_clients,
+                degraded=(path == "host_fallback"),
+                failed=bool(metrics.get(ROUND_FAILED)),
+            )
+            hbm = metrics.get(HBM_BYTES_IN_USE)
+            if hbm is not None:
+                health.note_hbm_sample(hbm)
 
     # -- the straggler/degradation ladder (ISSUE 8) --------------------
     def _aggregate_elastic(

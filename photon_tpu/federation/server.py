@@ -56,9 +56,12 @@ from photon_tpu.utils.profiling import (
     CHECKPOINT_TIME,
     CKPT_ASYNC_WRITE_S,
     CKPT_BARRIER_WAIT_S,
+    COMPILES_TOTAL,
     EVAL_ROUND_FAILED,
     EVAL_ROUND_SPAN,
     FIT_ROUND_TIME,
+    HBM_BYTES_IN_USE,
+    HBM_PEAK_BYTES,
     ROUND_FAILED,
     ROUND_SPAN,
     ROUND_TIME,
@@ -176,6 +179,8 @@ class ServerApp:
                 if tel.enabled
                 else None
             ),
+            # on-demand jax.profiler artifacts land beside trace-{run}.json
+            profile_dir=self.telemetry_dir,
         )
         self._prom = None
         self.server_steps_cumulative = 0
@@ -635,13 +640,36 @@ class ServerApp:
         if resumed is None and self.ckpt_mgr is not None and cfg.photon.checkpoint:
             self.save_checkpoint(0)  # round-0 checkpoint (reference: initialize_round)
 
-        # optional Prometheus /metrics endpoint over the live History
-        # (photon.telemetry.prom_port; stdlib HTTP, no dependency)
+        # optional Prometheus /metrics + /statusz + /debug/profile endpoint
+        # over the live History + observatory (photon.telemetry.prom_port;
+        # stdlib HTTP, no dependency)
         if cfg.photon.telemetry.enabled and cfg.photon.telemetry.prom_port:
             from photon_tpu.telemetry.prom import PromServer
 
-            self._prom = PromServer(self.history, cfg.photon.telemetry.prom_port)
+            self._prom = PromServer(
+                self.history, cfg.photon.telemetry.prom_port,
+                hub=telemetry.metrics_active(),
+                health=telemetry.health_active(),
+                profiler=telemetry.profiler_active(),
+            )
             self._prom.start()
+        # photon.telemetry.profile_rounds: arm the on-demand controller so
+        # the capture covers the FIRST N rounds (startup compile + steady
+        # state — the window the pjit-scaling playbook says to look at)
+        prof = telemetry.profiler_active()
+        if prof is not None and cfg.photon.telemetry.profile_rounds > 0:
+            from photon_tpu.telemetry.introspect import ProfileBusyError
+
+            try:
+                prof.request(cfg.photon.telemetry.profile_rounds, tag="startup")
+            except ProfileBusyError:
+                import warnings
+
+                warnings.warn(
+                    "telemetry.profile_rounds: a capture is already armed — "
+                    "skipping the startup profile",
+                    stacklevel=2,
+                )
 
         if cfg.fl.eval_interval_rounds and self.start_round == 1:
             t_pre = self.broadcast_parameters(0)
@@ -684,6 +712,12 @@ class ServerApp:
         if self._prom is not None:
             self._prom.close()
             self._prom = None
+        prof = telemetry.profiler_active()
+        if prof is not None:
+            # a capture armed for more rounds than the run had must still
+            # flush its artifact (stop_trace) — the trailing profile_tick
+            # only closes an exactly-full window
+            prof.close()
         tr = telemetry.active()
         if tr is None:
             return None
@@ -703,6 +737,10 @@ class ServerApp:
 
     def _round_loop(self, cfg: Config, n_rounds: int) -> None:
         for rnd in range(self.start_round, n_rounds + 1):
+            # on-demand profiling unit boundary (telemetry/introspect.py):
+            # an armed capture starts at the next round start and stops N
+            # round starts later — one None check when nothing is armed
+            telemetry.profile_tick("server/round")
             # one umbrella span per round (server/round — NOT the
             # round_time KPI name, which measures a narrower window): every
             # phase span below — and, via Envelope.trace, every client-side
@@ -713,6 +751,8 @@ class ServerApp:
             # when disabled; under the e2e fixture a steady-state round
             # that recompiles is billed to its round boundary
             steady_point("server/round")
+        # close an armed-for-more-rounds-than-the-run-had capture cleanly
+        telemetry.profile_tick("server/round")
 
     def _one_round(self, cfg: Config, rnd: int) -> None:
         if cfg.photon.refresh_period and rnd > 1 and (rnd - 1) % cfg.photon.refresh_period == 0:
@@ -732,6 +772,7 @@ class ServerApp:
                 raise
             failed = {ROUND_FAILED: 1.0}
             failed.update(self._membership_metrics())
+            self._observe_round_health(rnd, failed)
             self.history.record(rnd, failed)
             return
         metrics[BROADCAST_PRE_TIME] = t_pre
@@ -775,4 +816,32 @@ class ServerApp:
                     self.ckpt_mgr.last_barrier_wait_s
                 )
 
+        self._observe_round_health(rnd, metrics)
         self.history.record(rnd, metrics)
+
+    def _observe_round_health(self, rnd: int, metrics: dict) -> None:
+        """Run-health observatory hooks at the round boundary (ISSUE 10):
+        round-phase timings into typed histograms, HBM live/peak + backend
+        compile count sampled into the metrics dict AND the hub (program-
+        cache misses and memory growth become scrapeable KPIs), then the
+        NaN/Inf health sentinel over the assembled dict. One None check per
+        plane when telemetry is off."""
+        hub = telemetry.metrics_active()
+        if hub is not None:
+            from photon_tpu.telemetry.introspect import sample_device_plane
+
+            for key in (ROUND_TIME, FIT_ROUND_TIME, BROADCAST_PRE_TIME,
+                        CHECKPOINT_TIME):
+                v = metrics.get(key)
+                if v is not None:
+                    hub.histogram(key).observe(float(v))
+            sample_device_plane(
+                metrics, hub, hbm_key=HBM_BYTES_IN_USE,
+                peak_key=HBM_PEAK_BYTES, compiles_key=COMPILES_TOTAL,
+            )
+        health = telemetry.health_active()
+        if health is not None:
+            health.check_round_metrics(rnd, metrics)
+            hbm = metrics.get(HBM_BYTES_IN_USE)
+            if hbm is not None:
+                health.note_hbm_sample(hbm)

@@ -46,7 +46,9 @@ from photon_tpu.federation.messages import Ack, Envelope, Query
 from photon_tpu.utils.profiling import (
     EVENT_TCP_CORRUPT_FRAME,
     EVENT_TCP_RECONNECT,
+    TCP_RECV_BYTES,
     TCP_RECV_SPAN,
+    TCP_SEND_BYTES,
     TCP_SEND_SPAN,
 )
 
@@ -113,6 +115,10 @@ class SocketConn:
             with self._wlock:
                 for _ in range(repeat):
                     self.sock.sendall(header + data)
+        # frame-size distribution (typed hub, ISSUE 10): a control-plane
+        # payload quietly growing past the MB mark is a design regression
+        # the per-span nbytes attr can't aggregate
+        telemetry.metric_observe(TCP_SEND_BYTES, len(data))
 
     def _read_exact(self, n: int) -> bytes:
         buf = bytearray()
@@ -136,6 +142,7 @@ class SocketConn:
             # actual transport cost (payload read + unpickle) on a timeline
             with telemetry.timed_add(TCP_RECV_SPAN, nbytes=n):
                 data = self._read_exact(n)
+            telemetry.metric_observe(TCP_RECV_BYTES, n)
         if zlib.crc32(data) != crc:
             # the teardown this forces is a structured event: correlate the
             # connection loss with whatever round span was active

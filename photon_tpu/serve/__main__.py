@@ -71,7 +71,14 @@ def main(argv: list[str] | None = None) -> None:
         sc.port = args.port
     cfg.validate()
     if cfg.photon.telemetry.enabled:
-        telemetry.install(cfg.photon.telemetry, scope="serve")
+        # run-health observatory rides along (ISSUE 10): typed /metrics,
+        # /statusz health rollup, POST /debug/profile artifacts landing in
+        # the run's telemetry dir beside the training traces
+        telemetry.install(
+            cfg.photon.telemetry, scope="serve",
+            profile_dir=(cfg.photon.telemetry.dir
+                         or cfg.photon.save_path + "/telemetry"),
+        )
 
     store = FileStore(args.store) if args.store else None
     engine = PagedEngine.from_checkpoint(cfg, store=store, resume_round=args.round)

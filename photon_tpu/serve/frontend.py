@@ -34,13 +34,15 @@ import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
+from photon_tpu import telemetry
 from photon_tpu.serve.scheduler import (
     ContinuousBatcher,
     DrainingError,
     QueueFullError,
     serve_history_kpis,
 )
-from photon_tpu.telemetry.prom import render_history
+from photon_tpu.telemetry.introspect import ProfileBusyError
+from photon_tpu.telemetry.prom import negotiate_exposition, render_exposition
 
 
 class ServeFrontend:
@@ -108,15 +110,34 @@ class ServeFrontend:
                         "kpis": serve_history_kpis(fe.batcher.history),
                     })
                 elif path == "/metrics":
-                    body = render_history(fe.batcher.history).encode()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                    # typed instruments (TTFT/TPOT/queue-wait histograms,
+                    # HBM gauges, compile counters) + the KPI-History
+                    # bridge, one exposition — scrapes exactly like the
+                    # training plane's PromServer (exemplars only for
+                    # OpenMetrics-negotiating scrapers)
+                    want_om, ctype = negotiate_exposition(
+                        self.headers.get("Accept")
                     )
+                    body = render_exposition(
+                        fe.batcher.history, telemetry.metrics_active(),
+                        exemplars=want_om,
+                    ).encode()
+                    if want_om:
+                        body += b"# EOF\n"
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif path == "/statusz":
+                    h = telemetry.health_active()
+                    payload = (h.statusz() if h is not None
+                               else {"status": "ok", "planes": {},
+                                     "alerts": [], "telemetry": "off"})
+                    payload["draining"] = fe.draining
+                    self._json(200, payload)
                 else:
+                    self._discard_body()
                     self._json(404, {"error": f"no route {self.path!r}"})
 
             def _discard_body(self) -> None:
@@ -131,7 +152,11 @@ class ServeFrontend:
                     self.rfile.read(n)
 
             def do_POST(self) -> None:  # noqa: N802 — http.server API
-                if self.path.rstrip("/") != "/generate":
+                path = self.path.rstrip("/")
+                if path == "/debug/profile":
+                    self._debug_profile()
+                    return
+                if path != "/generate":
                     self._discard_body()
                     self._json(404, {"error": f"no route {self.path!r}"})
                     return
@@ -175,6 +200,35 @@ class ServeFrontend:
                     self._stream(req)
                 else:
                     self._blocking(req)
+
+            def _debug_profile(self) -> None:
+                """Arm the on-demand jax.profiler controller for N
+                scheduler ticks (ISSUE 10): 202 armed, 409 while a capture
+                is armed/active, 503 when telemetry is off."""
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._json(400, {"error": f"bad JSON body: {e}"})
+                    return
+                if not isinstance(body, dict):
+                    self._json(400, {"error": "body must be a JSON object"})
+                    return
+                p = telemetry.profiler_active()
+                if p is None:
+                    self._json(503, {"error": "no profiler installed "
+                                              "(telemetry disabled?)"})
+                    return
+                try:
+                    armed = p.request(int(body.get("units", 1)),
+                                      tag=str(body.get("tag", "serve")))
+                except ProfileBusyError as e:
+                    self._json(409, {"error": str(e), "status": p.status()})
+                    return
+                except (TypeError, ValueError) as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                self._json(202, {"armed": armed, "status": p.status()})
 
             def _blocking(self, req) -> None:
                 try:

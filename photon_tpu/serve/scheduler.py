@@ -40,15 +40,20 @@ from photon_tpu.analysis.runtime import steady_point
 from photon_tpu.metrics.history import History
 from photon_tpu.serve.engine import PagedEngine
 from photon_tpu.utils.profiling import (
+    SERVE_COMPILES_TOTAL,
     SERVE_DECODE_SPAN,
     SERVE_EVICTIONS,
+    SERVE_HBM_BYTES_IN_USE,
+    SERVE_HBM_PEAK_BYTES,
     SERVE_PREFILL_SPAN,
     SERVE_QUEUE_DEPTH,
     SERVE_QUEUE_SPAN,
+    SERVE_QUEUE_WAIT_S,
     SERVE_REJECTED,
     SERVE_REQUEST_SPAN,
     SERVE_SLOT_OCCUPANCY,
     SERVE_TOKENS_PER_S,
+    SERVE_TPOT_S,
     SERVE_TTFT_S,
 )
 
@@ -143,6 +148,9 @@ class ContinuousBatcher:
         #: so old ticks are trimmed rather than accumulating ~50 tuples/s
         #: of resident growth for the lifetime of the server
         self.max_kpi_ticks = 4096
+        #: device-plane introspection cadence: HBM/compile stats are
+        #: sampled every N scheduler ticks, not every tick
+        self.device_sample_ticks = 64
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "ContinuousBatcher":
@@ -256,6 +264,9 @@ class ContinuousBatcher:
             # any steady-state compile to the tick that caused it — the
             # machine-checked form of "admission never retraces"
             steady_point("serve/tick")
+            # on-demand profiling unit boundary (POST /debug/profile arms a
+            # capture over N ticks); one None check when nothing is armed
+            telemetry.profile_tick("serve/tick")
         self._drain_on_stop()
 
     def _admit_phase(self) -> None:
@@ -344,7 +355,8 @@ class ContinuousBatcher:
                 self.completed += 1
         if error is None:
             self.history.record(self._tick, {SERVE_TTFT_S: req.ttft_s})
-        self._emit_spans(req)
+        ctx = self._emit_spans(req)
+        self._observe_request(req, ctx, error)
         req._out.put(None)
 
     def _fail_all(self, msg: str) -> None:
@@ -369,18 +381,71 @@ class ContinuousBatcher:
     # -- telemetry ---------------------------------------------------------
     def _record_tick(self) -> None:
         self._tick += 1
-        self.history.record(self._tick, self.stats())
+        stats = self.stats()
+        hub = telemetry.metrics_active()
+        if hub is not None:
+            # typed twins of the tick KPIs: gauges for the point-in-time
+            # numbers, cumulative counters for the monotone ones (the
+            # History bridge keeps serving the per-tick series)
+            hub.gauge(SERVE_QUEUE_DEPTH).set(stats[SERVE_QUEUE_DEPTH])
+            hub.gauge(SERVE_SLOT_OCCUPANCY).set(stats[SERVE_SLOT_OCCUPANCY])
+            hub.counter(SERVE_EVICTIONS).inc_to(stats[SERVE_EVICTIONS])
+            hub.counter(SERVE_REJECTED).inc_to(stats[SERVE_REJECTED])
+            if (self._tick - 1) % self.device_sample_ticks == 0:
+                # HBM live/peak + backend compiles, sampled sparsely — a
+                # per-tick memory_stats() call would tax the decode cadence
+                from photon_tpu.telemetry.introspect import sample_device_plane
+
+                sample_device_plane(
+                    stats, hub, hbm_key=SERVE_HBM_BYTES_IN_USE,
+                    peak_key=SERVE_HBM_PEAK_BYTES,
+                    compiles_key=SERVE_COMPILES_TOTAL,
+                )
+        health = telemetry.health_active()
+        if health is not None:
+            health.check_serve_tick(
+                queue_depth=int(stats[SERVE_QUEUE_DEPTH]),
+                max_queue=self.max_queue,
+            )
+            hbm = stats.get(SERVE_HBM_BYTES_IN_USE)
+            if hbm is not None:
+                health.note_hbm_sample(hbm, plane="serve")
+        self.history.record(self._tick, stats)
         for series in self.history.rounds.values():
             if len(series) > self.max_kpi_ticks:
                 del series[: len(series) - self.max_kpi_ticks]
 
-    def _emit_spans(self, req: ServeRequest) -> None:
+    def _observe_request(self, req: ServeRequest, ctx: tuple | None,
+                         error: str | None) -> None:
+        """Per-request latency DISTRIBUTIONS into the typed hub (ISSUE 10):
+        TTFT, queue wait, and TPOT (decode seconds per output token after
+        the first). The exemplar is the request's umbrella span, so a fat
+        bucket links straight to the slow request's timeline. One None
+        check when telemetry is off; failed requests don't pollute the
+        latency histograms."""
+        hub = telemetry.metrics_active()
+        if hub is None or error is not None:
+            return
+        hub.histogram(SERVE_TTFT_S).observe(req.ttft_s, exemplar=ctx)
+        if req.t_admit:
+            hub.histogram(SERVE_QUEUE_WAIT_S).observe(
+                max(0.0, req.t_admit - req.t_submit), exemplar=ctx
+            )
+        n = len(req.generated)
+        if n > 1 and req.t_done > req.t_first:
+            hub.histogram(SERVE_TPOT_S).observe(
+                (req.t_done - req.t_first) / (n - 1), exemplar=ctx
+            )
+
+    def _emit_spans(self, req: ServeRequest) -> tuple | None:
         """Request phases as completed spans: a ``serve/request`` umbrella
         with queue/prefill/decode children. Wall-epoch anchored at emit
-        time (phase boundaries were captured on the monotonic clock)."""
+        time (phase boundaries were captured on the monotonic clock).
+        Returns the umbrella's ``(trace_id, span_id)`` for exemplar use,
+        or None when telemetry is off."""
         tr = telemetry.active()
         if tr is None:
-            return
+            return None
         now_wall, now_mono = time.time(), time.monotonic()
 
         def wall(t_mono: float) -> float:
@@ -399,6 +464,7 @@ class ContinuousBatcher:
         ):
             if a and b >= a:
                 tr.add_span(name, wall(a), b - a, parent=parent, rid=req.rid)
+        return parent
 
 
 def serve_history_kpis(history: History) -> dict[str, float]:

@@ -134,6 +134,10 @@ def aggregate_inplace(
         if tracer is not None:
             tracer.add_span(AGG_DECODE_TIME, t_wall, dt, parent=trace_parent,
                             client_index=n_seen[0])
+        # per-client decode seconds as a DISTRIBUTION (typed hub): a fat
+        # tail here is one slow client's payload, invisible in the summed
+        # KPI the same seconds accumulate into
+        telemetry.metric_observe(AGG_DECODE_TIME, dt)
         n_seen[0] += 1
         return arrays, n_cur
 
@@ -196,6 +200,7 @@ def aggregate_inplace(
             t_fold[0] += dt
             if tracer is not None:
                 tracer.add_span(AGG_FOLD_TIME, t_wall, dt, parent=trace_parent)
+            telemetry.metric_observe(AGG_FOLD_TIME, dt)
             n_total = n_new
     except BaseException:
         if pending is not None:

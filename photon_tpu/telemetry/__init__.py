@@ -27,13 +27,22 @@ module global and do nothing when it is ``None`` — with
 from __future__ import annotations
 
 import contextlib
+import pathlib
 from typing import Any, Iterator
 
+from photon_tpu.telemetry import introspect
 from photon_tpu.telemetry.events import EventLog, read_events_jsonl
+from photon_tpu.telemetry.health import HealthMonitor
+from photon_tpu.telemetry.introspect import ProfileController
+from photon_tpu.telemetry.metrics import MetricsHub
 from photon_tpu.telemetry.spans import Span, TraceContext, Tracer, new_id
+from photon_tpu.utils.profiling import SPANS_DROPPED
 
 __all__ = [
     "EventLog",
+    "HealthMonitor",
+    "MetricsHub",
+    "ProfileController",
     "Span",
     "TraceContext",
     "Tracer",
@@ -43,9 +52,16 @@ __all__ = [
     "drain_events",
     "emit_event",
     "events_active",
+    "health_active",
     "ingest",
     "install",
+    "metric_inc",
+    "metric_observe",
+    "metric_set",
+    "metrics_active",
     "new_id",
+    "profile_tick",
+    "profiler_active",
     "read_events_jsonl",
     "span",
     "uninstall",
@@ -53,6 +69,9 @@ __all__ = [
 
 _TRACER: Tracer | None = None
 _EVENTS: EventLog | None = None
+_METRICS: MetricsHub | None = None
+_HEALTH: HealthMonitor | None = None
+_PROFILER: ProfileController | None = None
 
 #: shared do-nothing context manager — the disabled-path ``span()`` return
 #: value, allocated once so the hook sites stay allocation-free
@@ -60,8 +79,11 @@ _NULL_CM = contextlib.nullcontext()
 
 
 def install(cfg, scope: str = "", events_path: str | None = None,
-            piggyback: bool = False) -> Tracer | None:
-    """Install (or clear) the process-global tracer + event log from a
+            piggyback: bool = False,
+            profile_dir: str | None = None) -> Tracer | None:
+    """Install (or clear) the process-global tracer + event log — and,
+    with them, the run-health observatory (typed-metric hub, health
+    monitor, compile counter, on-demand profile controller) — from a
     ``TelemetryConfig``.
 
     ``cfg=None`` or ``cfg.enabled=False`` uninstalls — constructing a
@@ -69,29 +91,59 @@ def install(cfg, scope: str = "", events_path: str | None = None,
     contract as ``chaos.install``). ``events_path`` switches the event log
     to write-through JSONL (the server); without it events buffer and ride
     the piggyback plane (nodes). ``piggyback`` marks the tracer's buffer as
-    drained-and-shipped by the node agent.
+    drained-and-shipped by the node agent. ``profile_dir`` is where
+    on-demand ``jax.profiler`` artifacts land (defaults to ``cfg.dir`` or
+    the events file's directory).
     """
-    global _TRACER, _EVENTS
+    global _TRACER, _EVENTS, _METRICS, _HEALTH, _PROFILER
     if cfg is None or not getattr(cfg, "enabled", False):
-        if _EVENTS is not None:
-            _EVENTS.close()
-        _TRACER = None
-        _EVENTS = None
+        uninstall()
         return None
     max_spans = int(getattr(cfg, "max_buffered_spans", 4096))
-    _TRACER = Tracer(scope, max_buffered_spans=max_spans, piggyback=piggyback)
+    tracer = Tracer(scope, max_buffered_spans=max_spans, piggyback=piggyback)
     if _EVENTS is not None:
         _EVENTS.close()
     _EVENTS = EventLog(scope, path=events_path, max_buffered=max_spans)
+    _METRICS = MetricsHub(retention=int(getattr(cfg, "metrics_retention", 512)))
+    _HEALTH = HealthMonitor()
+    if profile_dir is None:
+        profile_dir = getattr(cfg, "dir", "") or (
+            str(pathlib.Path(events_path).parent) if events_path else "."
+        )
+    if _PROFILER is not None:
+        _PROFILER.close()
+    _PROFILER = ProfileController(profile_dir)
+    introspect.install_compile_counter()
+    # span-drop accounting (ISSUE 10 satellite): the bounded buffer's
+    # discards feed a counter, and the FIRST drop of the run emits one
+    # warning event — observability of the observability
+    warned = [False]
+
+    def _on_drop(total: int) -> None:
+        hub = _METRICS
+        if hub is not None:
+            hub.counter(SPANS_DROPPED).inc()
+        if not warned[0]:
+            warned[0] = True
+            emit_event(SPANS_DROPPED, dropped_total=total, scope=scope)
+
+    tracer.on_drop = _on_drop
+    _TRACER = tracer
     return _TRACER
 
 
 def uninstall() -> None:
-    global _TRACER, _EVENTS
+    global _TRACER, _EVENTS, _METRICS, _HEALTH, _PROFILER
     if _EVENTS is not None:
         _EVENTS.close()
+    if _PROFILER is not None:
+        _PROFILER.close()
+    introspect.uninstall_compile_counter()
     _TRACER = None
     _EVENTS = None
+    _METRICS = None
+    _HEALTH = None
+    _PROFILER = None
 
 
 def active() -> Tracer | None:
@@ -101,6 +153,19 @@ def active() -> Tracer | None:
 
 def events_active() -> EventLog | None:
     return _EVENTS
+
+
+def metrics_active() -> MetricsHub | None:
+    """The installed typed-metric hub, or None (the one check per site)."""
+    return _METRICS
+
+
+def health_active() -> HealthMonitor | None:
+    return _HEALTH
+
+
+def profiler_active() -> ProfileController | None:
+    return _PROFILER
 
 
 # -- hook-site helpers (each is a None check when disabled) ---------------
@@ -175,3 +240,42 @@ def _timed_add_cm(tr: Tracer, name: str, attrs: dict) -> Iterator[None]:
         yield
     finally:
         tr.add_span(name, t_wall, _time.perf_counter() - t0, **attrs)
+
+
+# -- typed-metric hook helpers (each a single None check when disabled) ----
+
+def metric_inc(name: str, n: float = 1.0) -> None:
+    """Increment a counter on the installed hub; no-op when telemetry is
+    off. ``name`` must be a registry constant (metric-discipline lint)."""
+    hub = _METRICS
+    if hub is None:
+        return
+    hub.counter(name).inc(n)
+
+
+def metric_set(name: str, value: float) -> None:
+    """Set a gauge on the installed hub; no-op when telemetry is off."""
+    hub = _METRICS
+    if hub is None:
+        return
+    hub.gauge(name).set(value)
+
+
+def metric_observe(name: str, value: float) -> None:
+    """Observe into a histogram on the installed hub, attaching the active
+    span's trace context as the bucket exemplar; no-op when off."""
+    hub = _METRICS
+    if hub is None:
+        return
+    tr = _TRACER
+    ctx = tr.current_context() if tr is not None else None
+    hub.histogram(name).observe(value, exemplar=ctx)
+
+
+def profile_tick(label: str) -> None:
+    """Round/tick unit boundary for the on-demand profile controller
+    (server round loop, serve scheduler loop): one None check when no
+    controller is installed, two int reads when idle."""
+    p = _PROFILER
+    if p is not None:
+        p.tick(label)

@@ -125,6 +125,12 @@ class Tracer:
         self._lock = threading.Lock()
         self._spans: deque[Span] = deque()
         self.dropped = 0
+        #: drop-accounting hook (ISSUE 10 satellite): called OUTSIDE the
+        #: buffer lock with the cumulative drop count whenever the bounded
+        #: buffer discards a span — the telemetry plane wires it to the
+        #: ``telemetry/spans_dropped`` counter + a once-per-run warning
+        #: event, so overflow is observable instead of silent
+        self.on_drop = None
         self._tls = threading.local()
         # ingest dedup: a chaos-duplicated reply frame can drain in a LATER
         # scheduling window than its twin, where per-window mid dedup can't
@@ -204,11 +210,17 @@ class Tracer:
         return sp
 
     def _append(self, sp: Span) -> None:
+        dropped = 0
         with self._lock:
             if len(self._spans) >= self.max_buffered_spans:
                 self._spans.popleft()
                 self.dropped += 1
+                dropped = self.dropped
             self._spans.append(sp)
+        if dropped:
+            cb = self.on_drop
+            if cb is not None:
+                cb(dropped)
 
     # -- buffer ----------------------------------------------------------
     def drain(self) -> list[dict]:

@@ -148,6 +148,38 @@ SERVE_QUEUE_SPAN = "serve/queue"
 SERVE_PREFILL_SPAN = "serve/prefill"
 SERVE_DECODE_SPAN = "serve/decode"
 
+# -- run-health observatory instruments (ISSUE 10, telemetry/metrics.py) --
+# Histogram instruments on the serve plane (typed-metric hub, NOT History
+# KPIs: a latest-value gauge can't show a distribution):
+#: seconds per OUTPUT token after the first (decode cadence; the serving
+#: latency number TTFT doesn't cover)
+SERVE_TPOT_S = "serve/tpot_s"
+#: seconds a request waited in the admission queue before a slot opened
+SERVE_QUEUE_WAIT_S = "serve/queue_wait_s"
+
+# Device-plane introspection KPIs (telemetry/introspect.py), sampled at
+# round boundaries (server/*) and serve-tick boundaries (serve/*):
+#: live device (HBM) bytes on the first local device
+HBM_BYTES_IN_USE = "server/hbm_bytes_in_use"
+#: peak device bytes since process start
+HBM_PEAK_BYTES = "server/hbm_peak_bytes"
+#: cumulative backend compiles this process (program-cache misses are
+#: visible as this counter moving in steady state)
+COMPILES_TOTAL = "server/backend_compiles_total"
+SERVE_HBM_BYTES_IN_USE = "serve/hbm_bytes_in_use"
+SERVE_HBM_PEAK_BYTES = "serve/hbm_peak_bytes"
+SERVE_COMPILES_TOTAL = "serve/backend_compiles_total"
+
+# Instrument-only names (never History KPIs): transport frame sizes and
+# the observability-of-the-observability drop counter.
+#: TCP control-plane frame bytes, send leg (histogram)
+TCP_SEND_BYTES = "tcp/send_bytes"
+#: TCP control-plane frame bytes, recv leg (histogram)
+TCP_RECV_BYTES = "tcp/recv_bytes"
+#: spans discarded by the bounded tracer buffer (counter; also the kind of
+#: the once-per-run warning event emitted on the first drop)
+SPANS_DROPPED = "telemetry/spans_dropped"
+
 # -- structured event kinds (telemetry/events.py JSONL log) ---------------
 # Event names are registry constants for the same reason KPI/span names
 # are: photon-lint's kpi-registry rule flags any string literal at an
@@ -171,6 +203,23 @@ EVENT_COLLECTIVE_DEGRADED = "collective/degraded"
 #: fault-injector firings are ``chaos/<plan kind>`` (chaos/injector.py
 #: counters: tcp_drop, store_bitflip, crash, ...)
 CHAOS_EVENT_PREFIX = "chaos/"
+
+# -- structured alert kinds (telemetry/health.py, ISSUE 10) ---------------
+# Health watchers emit these as events (same registry discipline) AND
+# record them on the monitor's alert tail rolled up into /statusz.
+ALERT_EVENT_PREFIX = "alert/"
+#: NaN/Inf in the round's aggregated KPI dict (delta norm, server loss)
+ALERT_NONFINITE = "alert/nonfinite"
+#: straggler-percentile watcher over the collective cohort
+ALERT_STRAGGLERS = "alert/stragglers"
+#: a collective round on the degradation ladder / budget exhausted
+ALERT_DEGRADED_ROUNDS = "alert/degraded_rounds"
+#: serve admission queue pinned at its bound
+ALERT_QUEUE_SATURATION = "alert/queue_saturation"
+#: checkpoint-plane corruption (corrupt round skipped at resume)
+ALERT_STORE_CORRUPT = "alert/store_corrupt"
+#: live HBM growing monotonically across a full sample window
+ALERT_HBM_GROWTH = "alert/hbm_growth"
 
 #: dynamic metric-name families the registry can't enumerate statically:
 #: per-strategy-state norms (``server/{state_key}_norm``,

@@ -103,6 +103,55 @@ def test_registry_parse_matches_runtime_registry():
 
 
 # ---------------------------------------------------------------------------
+# rule family 1b: metric-discipline (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+def test_metric_discipline_flags_literals(tmp_path):
+    found = _lint(
+        tmp_path,
+        """
+        from photon_tpu import telemetry
+
+        def sites(hub, health):
+            hub.counter("serve/ttft_s")                    # stringly
+            hub.histogram("serve/definitely_a_typo")       # unknown
+            telemetry.metric_observe(f"serve/{1}_s", 0.1)  # f-string
+            health.alert("alert/nonfinite", plane="federation")  # stringly
+        """,
+        select=["metric-discipline"],
+    )
+    assert _rules(found) == {
+        "metric-discipline/stringly-name",
+        "metric-discipline/unregistered-name",
+        "metric-discipline/fstring-name",
+    }
+    assert len(found) == 4
+
+
+def test_metric_discipline_constants_pass(tmp_path):
+    found = _lint(
+        tmp_path,
+        """
+        from photon_tpu import telemetry
+        from photon_tpu.utils.profiling import (
+            ALERT_NONFINITE, SERVE_TTFT_S, SPANS_DROPPED,
+        )
+
+        def sites(hub, health, name):
+            hub.counter(SPANS_DROPPED).inc()
+            hub.histogram(SERVE_TTFT_S).observe(0.1)
+            hub.gauge(name)                      # dynamic name: not static
+            telemetry.metric_observe(SERVE_TTFT_S, 0.1)
+            telemetry.metric_inc(SPANS_DROPPED)
+            health.alert(ALERT_NONFINITE, plane="federation")
+        """,
+        select=["metric-discipline"],
+    )
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
 # rule family 2: hook-gating
 # ---------------------------------------------------------------------------
 
