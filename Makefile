@@ -65,17 +65,23 @@ lint-tests:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_analysis.py -q
 
-# serving smoke (ISSUE 5): the whole serving-plane suite — paged-cache
+# serving smoke (ISSUE 5 + 11): the whole serving-plane suite — paged-cache
 # bit-parity with the contiguous decoder, scheduler invariants, HTTP
 # round-trips (blocking + chunked streaming) against a real round
-# checkpoint — then the serving bench, whose exit code asserts continuous
-# batching beats the batch-synchronous baseline on tokens/s at 16
-# concurrent ragged requests. All of it rides tier-1 too (none is slow).
-# photon-lint preflight first: a rule regression (or a fresh violation in
-# serve/) fails the smoke before any engine compile burns minutes
+# checkpoint, the content-addressed prefix cache (refcounts, chain hashes,
+# cached-vs-cold per-step bit-parity, LRU pressure) and the live
+# checkpoint hot-swap (watcher state machine incl. the chaos
+# corrupt-candidate skip, zero-dropped-across-swap e2e) — then the serving
+# bench, whose exit code asserts continuous batching beats batch-sync at
+# 16 concurrent, the prefix cache cuts mean TTFT at 90% shared-prefix
+# traffic, and a live swap drops zero requests. All of it rides tier-1
+# too (none is slow). photon-lint preflight first: a rule regression (or
+# a fresh violation in serve/) fails the smoke before any engine compile
+# burns minutes
 serve-smoke: lint
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
-		tests/test_serve.py -q -m "slow or not slow"
+		tests/test_serve.py tests/test_serve_prefix.py tests/test_hotswap.py \
+		-q -m "slow or not slow"
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --serving
 
 # the chaos-marked fault-injection + elasticity suite (incl. the slow

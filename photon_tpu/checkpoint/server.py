@@ -247,6 +247,24 @@ class ServerCheckpointManager:
     def valid_rounds(self, state_keys: tuple[str, ...] = ()) -> list[int]:
         return [r for r in self.list_rounds() if self.is_valid_round(r, state_keys)]
 
+    def latest_complete_round(self, run_uuid: str | None = None) -> int | None:
+        """Newest round whose MANIFEST object is present, or None.
+
+        The cheap poll for the serving hot-swap watcher (ISSUE 11): the
+        manifest is written LAST and object writes are atomic, so its
+        presence alone marks the round's objects all landed — a torn or
+        in-flight round (params up, manifest not yet) is never reported.
+        Pure presence scan: no object reads, no checksum work — the
+        watcher pays :meth:`verify_round`'s read-back only once per NEW
+        candidate, not per poll. (Pre-manifest legacy rounds are invisible
+        here by design; a tracking watcher wants completed rounds of a
+        LIVE run, which always writes manifests.)"""
+        for r in reversed(self.list_rounds(run_uuid)):
+            key = f"{self._round_prefix(r, run_uuid)}/{MANIFEST_FILE}"
+            if self.store.exists(key):
+                return r
+        return None
+
     def resolve_resume_round(self, resume_round: int, state_keys: tuple[str, ...] = ()) -> int:
         """Non-negative → that round (validated, incl. checksums). Negative →
         index from the latest valid round: −1 = latest, −2 = one before, ...

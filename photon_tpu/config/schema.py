@@ -393,6 +393,26 @@ class ServeConfig:
     # /generate gets 503 + Retry-After, and in-flight slots get up to this
     # many seconds to finish before the scheduler hard-stops
     drain_timeout_s: float = 30.0
+    # content-addressed prefix reuse (ISSUE 11, serve/prefix.py): hash full
+    # prompt-prefix blocks and share their KV copy-on-write across requests
+    # — prefill then runs only on each prompt's uncached suffix. OFF by
+    # default (the finished-request blocks a cache pins shrink the free
+    # pool until evicted under pressure); ignored for MoE models, where
+    # batch-global expert capacity breaks the sharing parity argument.
+    prefix_cache: bool = False
+    # explicit cap on cached (hash-indexed) blocks; 0 = no cap beyond pool
+    # pressure (admission evicts LRU entries whenever it needs free blocks)
+    prefix_cache_blocks: int = 0
+    # live checkpoint hot-swap (ISSUE 11, serve/hotswap.py): a watcher
+    # thread polls the federated run's store and swaps manifest-verified
+    # new rounds in at the scheduler swap point — zero dropped requests,
+    # every request served end to end by exactly one round's params
+    hotswap: bool = False
+    hotswap_poll_s: float = 5.0  # store poll cadence (presence scan only)
+    # optional federation-health gate: the TRAINING run's /statusz URL; a
+    # "failing" federation plane blocks swaps (don't track a failing run).
+    # Unreachable endpoints fail open — see serve/hotswap.py.
+    hotswap_statusz_url: str = ""
 
 
 @dataclass
@@ -699,6 +719,15 @@ class Config:
             )
         if not 0 <= srv.port <= 65535:
             raise ValueError(f"serve.port must be in [0, 65535], got {srv.port}")
+        if srv.prefix_cache_blocks < 0:
+            raise ValueError(
+                f"serve.prefix_cache_blocks must be >= 0 (0 = no cap), got "
+                f"{srv.prefix_cache_blocks}"
+            )
+        if srv.hotswap_poll_s <= 0:
+            raise ValueError(
+                f"serve.hotswap_poll_s must be > 0, got {srv.hotswap_poll_s}"
+            )
         tel = self.photon.telemetry
         if not 0 <= tel.prom_port <= 65535:
             raise ValueError(

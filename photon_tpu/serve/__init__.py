@@ -1,21 +1,29 @@
-"""Continuous-batching inference plane (``photon.serve``, ISSUE 5).
+"""Multi-tenant serving daemon (``photon.serve``, ISSUE 5 + 11).
 
-Closes the train→serve loop: after four PRs of federation, aggregation,
-checkpointing and tracing, this package loads a federated run's server
-round checkpoint and answers prompts with it.
+Closes the train→serve loop: this package loads a federated run's server
+round checkpoint, answers prompts with it, and — hot-swap on — tracks
+the live run round by round with zero dropped requests.
 
-Four layers, each testable alone:
+Six layers, each testable alone:
 
 - :mod:`cache` — the paged KV pool: fixed block pool + per-slot block
-  tables + free-list recycling, with a gather-based decode step that is
-  bit-exact with the contiguous ``models/decode.py`` greedy path;
+  tables + REFCOUNTED free-list recycling, a gather-based decode step
+  that is bit-exact with the contiguous ``models/decode.py`` greedy
+  path, and a suffix-only prefill for prefix-cache hits (same parity
+  bar);
+- :mod:`prefix` — content-addressed prefix reuse: chain-hashed full
+  prompt blocks shared copy-on-write across requests through an LRU of
+  allocator-referenced blocks;
 - :mod:`engine` — the jit'd fixed-shape slot engine (admission never
-  retraces), params-only checkpoint loading, per-request greedy/seeded
-  sampling;
+  retraces, hit or miss), params-only checkpoint loading, per-request
+  greedy/seeded sampling, the hot-swap reference assignment;
 - :mod:`scheduler` — the continuous batcher: bounded admission queue with
   reject-not-buffer backpressure, FIFO admission, mid-flight eviction +
-  refill, prefill/decode interleave budget, ``serve/*`` KPIs + request
-  spans;
+  refill, prefill/decode interleave budget, the param-swap point,
+  ``serve/*`` KPIs + request spans;
+- :mod:`hotswap` — the checkpoint watcher: manifest-presence polling,
+  CRC verification (corrupt candidates skipped, never swapped), the
+  /statusz federation-health gate, the drain fence;
 - :mod:`frontend` — stdlib HTTP ``/generate`` (blocking + chunked
   streaming), ``/healthz``, Prometheus ``/metrics``.
 
@@ -31,15 +39,20 @@ for the serving plane.
 from photon_tpu.serve.cache import BlockAllocator, PagedState, paged_decode_step
 from photon_tpu.serve.engine import PagedEngine
 from photon_tpu.serve.frontend import ServeFrontend
+from photon_tpu.serve.hotswap import CheckpointWatcher
+from photon_tpu.serve.prefix import PrefixCache, prefix_hashes
 from photon_tpu.serve.scheduler import ContinuousBatcher, QueueFullError, ServeRequest
 
 __all__ = [
     "BlockAllocator",
+    "CheckpointWatcher",
     "ContinuousBatcher",
     "PagedEngine",
     "PagedState",
+    "PrefixCache",
     "QueueFullError",
     "ServeFrontend",
     "ServeRequest",
     "paged_decode_step",
+    "prefix_hashes",
 ]

@@ -80,7 +80,9 @@ def main(argv: list[str] | None = None) -> None:
                          or cfg.photon.save_path + "/telemetry"),
         )
 
-    store = FileStore(args.store) if args.store else None
+    store = FileStore(args.store) if args.store else FileStore(
+        cfg.photon.save_path + "/store"
+    )
     engine = PagedEngine.from_checkpoint(cfg, store=store, resume_round=args.round)
     batcher = ContinuousBatcher(
         engine,
@@ -97,6 +99,19 @@ def main(argv: list[str] | None = None) -> None:
         batcher, host=sc.host, port=sc.port,
         max_new_tokens_cap=sc.max_new_tokens, tokenizer=tokenizer,
     )
+    watcher = None
+    if sc.hotswap:
+        # track the federated run live (ISSUE 11): poll the store, verify
+        # candidate rounds through the manifest CRCs, swap at the
+        # scheduler swap point — zero dropped requests across a swap
+        from photon_tpu.checkpoint.server import ServerCheckpointManager
+        from photon_tpu.serve.hotswap import CheckpointWatcher
+
+        watcher = CheckpointWatcher(
+            batcher, ServerCheckpointManager(store, cfg.run_uuid), cfg,
+            poll_s=sc.hotswap_poll_s, statusz_url=sc.hotswap_statusz_url,
+        ).start()
+        frontend.watcher = watcher
     port = frontend.start()
     print(json.dumps({
         "serving": f"http://{sc.host}:{port}",
@@ -105,6 +120,8 @@ def main(argv: list[str] | None = None) -> None:
         "n_slots": engine.n_slots,
         "n_blocks": engine.n_blocks,
         "block_size": engine.block_size,
+        "prefix_cache": engine.prefix_cache is not None,
+        "hotswap": watcher is not None,
     }), flush=True)
 
     # SIGTERM = graceful drain (ISSUE 8 satellite): healthz flips to
@@ -123,6 +140,11 @@ def main(argv: list[str] | None = None) -> None:
     try:
         stop.wait()
     finally:
+        # the watcher stops FIRST either way: a swap staged mid-shutdown
+        # would churn params under the drain (its poll path also refuses
+        # on its own once the batcher reports draining)
+        if watcher is not None:
+            watcher.close()
         if graceful.is_set():
             frontend.mark_draining()
             batcher.drain(sc.drain_timeout_s)

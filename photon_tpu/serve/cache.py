@@ -53,6 +53,13 @@ class BlockAllocator:
     LIFO recycling (a just-freed block is the next handed out) keeps the
     hot working set small. Guards double-free and foreign ids: the
     scheduler's no-leak invariant is only as strong as this accounting.
+
+    Blocks are REFCOUNTED (ISSUE 11): ``alloc`` hands out ids at refcount
+    1, :meth:`retain` adds a reference (the prefix cache sharing a block
+    into another slot's table, or pinning it in its LRU), and :meth:`free`
+    decrements — only a refcount hitting zero returns the block to the
+    free list. The double-free guard survives sharing: freeing an id with
+    no outstanding reference still raises :class:`BlockLeakError`.
     """
 
     def __init__(self, n_blocks: int) -> None:
@@ -60,29 +67,55 @@ class BlockAllocator:
             raise ValueError(f"need n_blocks >= 1, got {n_blocks}")
         self.n_blocks = n_blocks
         self._free: list[int] = list(range(n_blocks - 1, -1, -1))
-        self._held: set[int] = set()
+        self._refs: dict[int, int] = {}
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
 
+    @property
+    def held_blocks(self) -> int:
+        return len(self._refs)
+
+    def refcount(self, block: int) -> int:
+        """Outstanding references on ``block`` (0 = on the free list)."""
+        return self._refs.get(block, 0)
+
     def alloc(self, n: int) -> list[int] | None:
-        """``n`` physical ids, or None (and NO partial allocation) when the
-        pool can't cover the request."""
+        """``n`` physical ids at refcount 1, or None (and NO partial
+        allocation) when the pool can't cover the request."""
         if n < 0:
             raise ValueError(f"need n >= 0, got {n}")
         if n > len(self._free):
             return None
         ids = [self._free.pop() for _ in range(n)]
-        self._held.update(ids)
+        for b in ids:
+            self._refs[b] = 1
         return ids
 
-    def free(self, ids: list[int]) -> None:
+    def retain(self, ids: list[int]) -> None:
+        """One more reference on each (already held) id — the copy-on-write
+        share: a block mapped into a second slot's table, or indexed by the
+        prefix cache. Retaining a free/foreign id is a BlockLeakError (it
+        would resurrect a block the free list may hand out again)."""
         for b in ids:
-            if b not in self._held:
+            if b not in self._refs:
+                raise BlockLeakError(f"retaining block {b} not currently held")
+        for b in ids:
+            self._refs[b] += 1
+
+    def free(self, ids: list[int]) -> None:
+        """Drop one reference per id; refcount-zero blocks return to the
+        free list. A shared block survives until its LAST holder frees."""
+        for b in ids:
+            refs = self._refs.get(b, 0)
+            if refs < 1:
                 raise BlockLeakError(f"freeing block {b} not currently held")
-            self._held.remove(b)
-            self._free.append(b)
+            if refs == 1:
+                del self._refs[b]
+                self._free.append(b)
+            else:
+                self._refs[b] = refs - 1
 
 
 @flax.struct.dataclass
@@ -187,6 +220,99 @@ def admit_write(state: PagedState, slot: jax.Array, row_ids: jax.Array,
         cache_v=state.cache_v.at[targets].set(
             vb.swapaxes(0, 1).astype(state.cache_v.dtype)),
         block_tables=state.block_tables.at[slot].set(row_ids),
+        lengths=state.lengths.at[slot].set(length),
+    )
+
+
+def suffix_prefill_admit(params: dict, state: PagedState, slot: jax.Array,
+                         row_pad: jax.Array, tokens: jax.Array,
+                         start: jax.Array, length: jax.Array,
+                         cfg: ModelConfig) -> tuple[jax.Array, PagedState]:
+    """Prefill ONLY a prompt's uncached suffix through the paged pool
+    (ISSUE 11): positions ``[start, start + s_pad)`` attend through the
+    slot's block-table row — whose first ``start / block_size`` physical
+    blocks hold a cache-hit prefix's KV, computed by some earlier prefill —
+    while the suffix's own k/v scatter into the freshly-allocated suffix
+    blocks. Returns (next-token logits ``[1, V]`` at the prompt's cursor,
+    advanced state with ``slot``'s table row and length installed).
+
+    Bit-parity argument (pinned by ``tests/test_serve_prefix.py``): the
+    cached prefix KV is bitwise what a cold full-prompt prefill computes
+    for those positions (causality: position ``p``'s k/v depend only on
+    tokens ``<= p``; masked pad contributions are exactly zero), and this
+    function mirrors the decode-step einsum formulation op for op, so its
+    logits AND the suffix KV it writes equal the cold path's bitwise.
+
+    Shape discipline: ``tokens`` is ``[1, s_pad]`` with ``s_pad`` bucketed
+    to a power-of-two block count (same buckets as cold prefill → at most
+    ``log2(max_blocks) + 1`` compiles); ``start``/``length``/``slot`` ride
+    as traced scalars so prefix depth never retraces. ``row_pad`` is the
+    table row EXTENDED by ``s_pad / block_size`` trash entries: the
+    suffix-block slice ``row_pad[start//bs : start//bs + s_pad//bs]`` can
+    then never clamp (a clamped dynamic slice would silently misalign the
+    scatter into live blocks), and pad blocks past the reservation write
+    into the trash block exactly like ``admit_write``'s tail.
+
+    COW invariant: ``start`` is a whole-block boundary and every write here
+    targets ``row_pad`` entries at block index ``>= start // bs`` — a
+    shared (cached) prefix block is never written."""
+    n_kv = cfg.n_kv_heads or cfg.n_heads
+    group = cfg.n_heads // n_kv
+    bs = state.block_size
+    m = state.block_tables.shape[1]
+    s_ctx = m * bs
+    _, s_pad = tokens.shape
+    n_suf = s_pad // bs
+    row = jax.lax.dynamic_slice(row_pad, (0,), (m,))
+    targets = jax.lax.dynamic_slice(row_pad, (start // bs,), (n_suf,))
+    pos = start + jnp.arange(s_pad)[None, :]  # [1, s_pad] absolute positions
+    x = _embed(params, tokens, pos, cfg)[0]  # [s_pad, D]
+    scale = 1.0 / (cfg.d_head ** 0.5)
+    k_pos = jnp.arange(s_ctx)
+    valid = (k_pos[None, :] <= pos[0][:, None])  # [s_pad, s_ctx] causal+garbage
+
+    ck_l = jnp.moveaxis(state.cache_k, 1, 0)  # [L, NB, bs, H, D] view
+    cv_l = jnp.moveaxis(state.cache_v, 1, 0)
+
+    def layer(x, xs):
+        lp, ck, cv = xs  # ck/cv: [NB, bs, H_kv, Dh] — this layer's pool
+        h = _norm(x, lp["ln_1"]["scale"], lp["ln_1"].get("bias"),
+                  cfg.norm, cfg.norm_eps)
+        q, k_new, v_new = _qkv(lp, h, cfg)  # q [s_pad,H,Dh], k/v [s_pad,Hkv,Dh]
+        if cfg.rope:
+            q = _rope_at(q[None], pos, cfg.rope_theta)[0]
+            k_new = _rope_at(k_new[None], pos, cfg.rope_theta)[0]
+        # scatter the suffix k/v into its physical blocks FIRST (write →
+        # gather, the paged_decode_step discipline), pad blocks → trash
+        kb = k_new.reshape(n_suf, bs, n_kv, cfg.d_head)
+        vb = v_new.reshape(n_suf, bs, n_kv, cfg.d_head)
+        ck = ck.at[targets].set(kb.astype(ck.dtype))
+        cv = cv.at[targets].set(vb.astype(cv.dtype))
+        # block-table gather → the slot's logical [s_ctx, H, D] view
+        gk = ck[row].reshape(s_ctx, n_kv, cfg.d_head)
+        gv = cv[row].reshape(s_ctx, n_kv, cfg.d_head)
+        qg = q.reshape(s_pad, n_kv, group, cfg.d_head)
+        scores = jnp.einsum("qkgd,skd->qkgs", qg, gk,
+                            preferred_element_type=jnp.float32) * scale
+        if cfg.alibi:
+            dist = (pos[0][:, None] - k_pos[None, :]).astype(jnp.float32)
+            slopes = alibi_slopes(cfg.n_heads).reshape(n_kv, group)
+            scores = scores - slopes[None, :, :, None] * dist[:, None, None, :]
+        scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("qkgs,skd->qkgd", probs.astype(gv.dtype), gv)
+        x = x + _dense(lp, "out_proj", out.reshape(s_pad, cfg.d_model))
+        return _mlp(lp, x, cfg), (ck, cv)
+
+    x, (ck_l, cv_l) = jax.lax.scan(
+        layer, x, (params["blocks"]["block"], ck_l, cv_l)
+    )
+    last = x[length - start - 1]  # the prompt's final (real) suffix token
+    logits = _logits(params, last[None], cfg)
+    return logits, PagedState(
+        cache_k=jnp.moveaxis(ck_l, 0, 1),
+        cache_v=jnp.moveaxis(cv_l, 0, 1),
+        block_tables=state.block_tables.at[slot].set(row),
         lengths=state.lengths.at[slot].set(length),
     )
 

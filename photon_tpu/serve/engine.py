@@ -45,7 +45,9 @@ from photon_tpu.serve.cache import (
     admit_write,
     init_paged_state,
     paged_decode_step,
+    suffix_prefill_admit,
 )
+from photon_tpu.serve.prefix import PrefixCache, prefix_hashes
 
 
 def _sample_rows(logits: jax.Array, temps: jax.Array,
@@ -58,6 +60,21 @@ def _sample_rows(logits: jax.Array, temps: jax.Array,
 
 
 _sample_jit = jax.jit(_sample_rows)
+
+
+def load_serving_params(cfg: Config, mgr: Any, server_round: int) -> Any:
+    """Params-only load + model-template restore for serving consumers
+    (shared by :meth:`PagedEngine.from_checkpoint` and the hot-swap
+    watcher, ``serve/hotswap.py``): no dead optimizer moments, aggregated
+    momenta split off when the run shipped them."""
+    from photon_tpu.codec import params_from_ndarrays
+    from photon_tpu.models.mpt import init_params
+    from photon_tpu.train.param_ops import has_momenta, split_momenta
+
+    meta, arrays = mgr.load_round_params(server_round)
+    if has_momenta(meta):
+        meta, arrays, _, _ = split_momenta(meta, arrays)
+    return params_from_ndarrays(init_params(cfg.model, seed=0), meta, arrays)
 
 
 class PagedEngine:
@@ -74,6 +91,18 @@ class PagedEngine:
         self.loaded_round = loaded_round
         self.params = jax.tree.map(jnp.asarray, params)
         self.allocator = BlockAllocator(self.n_blocks)
+        # content-addressed prefix reuse (ISSUE 11, serve/prefix.py): OFF
+        # unless opted in, and never for MoE — expert-capacity routing is
+        # batch-global, so a prefix block's KV is not a pure function of
+        # its tokens there and cross-request sharing would break parity
+        self.prefix_cache: PrefixCache | None = None
+        if getattr(sc, "prefix_cache", False) and self.mc.mlp != "moe":
+            self.prefix_cache = PrefixCache(
+                self.allocator,
+                max_blocks=getattr(sc, "prefix_cache_blocks", 0),
+            )
+        # single-slot chain-hash memo (see _chain_hashes)
+        self._hash_memo: tuple[list[int], int, list[bytes]] | None = None
         self.state: PagedState = init_paged_state(
             self.mc, self.n_slots, self.n_blocks, self.block_size, self.max_blocks
         )
@@ -97,6 +126,13 @@ class PagedEngine:
         # op-by-op host scatter costs ~10 dispatches per admission on a
         # 1-core host, which would tax BOTH sides of the serving bench
         self._admit_write = jax.jit(admit_write, donate_argnums=0)
+        # suffix-only admission for prefix-cache hits: one compile per
+        # suffix bucket (the same pow2 block-count buckets as cold prefill)
+        self._suffix_admit = jax.jit(
+            lambda p, st, slot, row, tok, start, length:
+            suffix_prefill_admit(p, st, slot, row, tok, start, length, mc),
+            donate_argnums=1,
+        )
 
     # -- checkpoint loading ----------------------------------------------
     @classmethod
@@ -107,18 +143,27 @@ class PagedEngine:
         shipped them, restore onto the model template."""
         from photon_tpu.checkpoint import FileStore
         from photon_tpu.checkpoint.server import ServerCheckpointManager
-        from photon_tpu.codec import params_from_ndarrays
-        from photon_tpu.models.mpt import init_params
-        from photon_tpu.train.param_ops import has_momenta, split_momenta
 
         store = store or FileStore(cfg.photon.save_path + "/store")
         mgr = ServerCheckpointManager(store, cfg.run_uuid)
         rnd = mgr.resolve_resume_round(resume_round)
-        meta, arrays = mgr.load_round_params(rnd)
-        if has_momenta(meta):
-            meta, arrays, _, _ = split_momenta(meta, arrays)
-        params = params_from_ndarrays(init_params(cfg.model, seed=0), meta, arrays)
-        return cls(cfg, params, loaded_round=rnd)
+        return cls(cfg, load_serving_params(cfg, mgr, rnd), loaded_round=rnd)
+
+    def set_params(self, params: Any, loaded_round: int | None = None) -> None:
+        """The hot-swap reference assignment (ISSUE 11): install a new
+        round's params. MUST be called from the scheduler driver thread at
+        a swap point with zero active slots — in-flight requests always
+        run end to end on one round's params. Flushes the prefix cache:
+        KV computed under the old params is invalid under the new."""
+        if self._active.any():
+            raise RuntimeError(
+                f"param swap with {int(self._active.sum())} active slots — "
+                "the scheduler must quiesce first"
+            )
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.loaded_round = loaded_round
+        if self.prefix_cache is not None:
+            self.prefix_cache.flush()
 
     # -- capacity ---------------------------------------------------------
     def blocks_needed(self, prompt_len: int, max_new: int) -> int:
@@ -137,10 +182,57 @@ class PagedEngine:
                 and self.blocks_needed(prompt_len, max_new)
                 <= min(self.max_blocks, self.n_blocks))
 
-    def can_admit(self, prompt_len: int, max_new: int) -> bool:
-        return (self.free_slot() is not None
-                and self.allocator.free_blocks
-                >= self.blocks_needed(prompt_len, max_new))
+    def can_admit(self, prompt_len: int, max_new: int,
+                  prompt: list[int] | None = None) -> bool:
+        """With ``prompt`` given and the prefix cache on, admissibility
+        accounts for cache hits (fewer fresh blocks needed) AND for
+        reclaimable cache-held blocks (entries no live slot shares —
+        evictable under pressure by :meth:`admit`'s ``ensure_free``)."""
+        if self.free_slot() is None:
+            return False
+        hit, fresh_needed, _ = self._prefix_plan(
+            prompt if prompt is not None else [], prompt_len, max_new,
+            touch=False,
+        )
+        avail = self.allocator.free_blocks
+        if self.prefix_cache is not None:
+            avail += self.prefix_cache.reclaimable(exclude=set(hit))
+        return avail >= fresh_needed
+
+    def _prefix_plan(self, prompt: list[int], prompt_len: int, max_new: int,
+                     touch: bool = True) -> tuple[list[int], int, list[bytes]]:
+        """(cached-prefix physical blocks, fresh blocks still needed, the
+        prompt's full-block chain hashes — ALL of them, up to
+        ``prompt_len // block_size``, so admission can reuse this one
+        sweep for both lookup and insert). Lookups are capped one block
+        short of the prompt's end so the suffix always keeps at least the
+        final prompt token — its forward pass produces the first sampled
+        token's logits. ``touch=False`` = read-only peek (can_admit's
+        per-tick retries must not reshuffle LRU order)."""
+        need = self.blocks_needed(prompt_len, max_new)
+        if self.prefix_cache is None or not prompt:
+            return [], need, []
+        hit = self.prefix_cache.lookup(
+            self._chain_hashes(prompt, prompt_len)[
+                : (prompt_len - 1) // self.block_size
+            ],
+            touch=touch,
+        )
+        return hit, need - len(hit), self._chain_hashes(prompt, prompt_len)
+
+    def _chain_hashes(self, prompt: list[int], prompt_len: int) -> list[bytes]:
+        """One chain-hash sweep per prompt LIST OBJECT: a single-slot memo
+        keyed by identity (the memo holds the list alive, so the ``is``
+        check can never alias a recycled id). Covers the can_admit→admit
+        pair and a capacity-blocked queue head's per-tick retries —
+        hashing is content-pure, so a stale entry is impossible."""
+        memo = self._hash_memo
+        if memo is not None and memo[0] is prompt and memo[1] == prompt_len:
+            return memo[2]
+        hashes = prefix_hashes(prompt, self.block_size,
+                               limit=prompt_len // self.block_size)
+        self._hash_memo = (prompt, prompt_len, hashes)
+        return hashes
 
     def free_slot(self) -> int | None:
         idle = np.flatnonzero(~self._active)
@@ -153,6 +245,19 @@ class PagedEngine:
     @property
     def free_blocks(self) -> int:
         return self.allocator.free_blocks
+
+    def prefix_stats(self) -> dict | None:
+        """Prefix-cache counters for /healthz and the KPI tick (None when
+        the cache is off)."""
+        pc = self.prefix_cache
+        if pc is None:
+            return None
+        return {
+            "entries": len(pc),
+            "hit_rate": round(pc.hit_rate, 4),
+            "evictions": pc.evictions,
+            "tokens_cached": pc.tokens_cached,
+        }
 
     # -- admission / step / eviction --------------------------------------
     def _bucket(self, prompt_len: int) -> int:
@@ -168,7 +273,12 @@ class PagedEngine:
         request's FIRST generated token. Reserves the worst case
         ``blocks_needed(len, max_new)`` up front — an admitted request can
         never die of pool exhaustion mid-flight (the no-preemption design;
-        docs/serving.md)."""
+        docs/serving.md).
+
+        With the prefix cache on, the longest cached full-block prefix is
+        mapped copy-on-write into the slot's table (one retain per shared
+        block — never written: decode's first write lands strictly past
+        it) and prefill runs only on the uncached suffix."""
         if self._active[slot]:
             raise RuntimeError(f"slot {slot} is occupied")
         n = len(prompt)
@@ -176,37 +286,91 @@ class PagedEngine:
             raise ValueError(
                 f"request needs {n}+{max_new} tokens > slot capacity {self.s_cap}"
             )
-        ids = self.allocator.alloc(self.blocks_needed(n, max_new))
-        if ids is None:
-            raise RuntimeError("paged pool exhausted (caller must can_admit first)")
+        hit, fresh_needed, hashes = self._prefix_plan(prompt, n, max_new)
+        k = len(hit)
+        ids: list[int] | None = None
+        retained = False
         try:
-            s_pad = max(self._bucket(n), n)
-            tokens = np.zeros((1, s_pad), np.int32)
-            tokens[0, :n] = prompt
-            lengths = jnp.asarray([n], jnp.int32)
-            logits, cst = self._prefill_jit(self.params, jnp.asarray(tokens), lengths)
-            row_ids = np.full(self.max_blocks, self.n_blocks, np.int32)
-            row_ids[: len(ids)] = ids
-            self.state = self._admit_write(
-                self.state, jnp.int32(slot), jnp.asarray(row_ids),
-                cst.cache_k, cst.cache_v, jnp.int32(n),
-            )
+            if hit:
+                # pin the shared blocks BEFORE any eviction can run: an
+                # ensure_free dropping a hit entry now only un-indexes it
+                # (our reference keeps the block — and its bytes — live)
+                self.allocator.retain(hit)
+                retained = True
+            pc = self.prefix_cache
+            if pc is not None and fresh_needed > self.allocator.free_blocks:
+                pc.ensure_free(fresh_needed)
+            ids = self.allocator.alloc(fresh_needed)
+            if ids is None:
+                raise RuntimeError(
+                    "paged pool exhausted (caller must can_admit first)"
+                )
+            row_blocks = hit + ids
+            if k == 0:
+                # cold path: full-prompt prefill (unchanged — the original
+                # bit-parity path, also what every cache MISS takes)
+                s_pad = max(self._bucket(n), n)
+                tokens = np.zeros((1, s_pad), np.int32)
+                tokens[0, :n] = prompt
+                lengths = jnp.asarray([n], jnp.int32)
+                logits, cst = self._prefill_jit(
+                    self.params, jnp.asarray(tokens), lengths
+                )
+                row_ids = np.full(self.max_blocks, self.n_blocks, np.int32)
+                row_ids[: len(ids)] = ids
+                self.state = self._admit_write(
+                    self.state, jnp.int32(slot), jnp.asarray(row_ids),
+                    cst.cache_k, cst.cache_v, jnp.int32(n),
+                )
+            else:
+                # warm path: prefill ONLY the uncached suffix, attending
+                # through the shared prefix blocks via the table row
+                start = k * self.block_size
+                suffix = prompt[start:]
+                s_pad = max(self._bucket(len(suffix)), len(suffix))
+                n_suf = s_pad // self.block_size
+                tokens = np.zeros((1, s_pad), np.int32)
+                tokens[0, : len(suffix)] = suffix
+                # row + n_suf trash entries: the in-program suffix-block
+                # slice can never clamp, pad blocks land in the trash
+                row_pad = np.full(self.max_blocks + n_suf, self.n_blocks,
+                                  np.int32)
+                row_pad[: len(row_blocks)] = row_blocks
+                logits, self.state = self._suffix_admit(
+                    self.params, self.state, jnp.int32(slot),
+                    jnp.asarray(row_pad), jnp.asarray(tokens),
+                    jnp.int32(start), jnp.int32(n),
+                )
             sub, carry = jax.random.split(jax.random.PRNGKey(seed))
             first = int(_sample_jit(
                 logits, jnp.asarray([temperature], jnp.float32), sub[None]
             )[0])
         except BaseException:
-            # transactional: a failed admission must not leak its blocks.
-            # A partially-written table row is harmless — the decode step
-            # trash-routes every INACTIVE slot's writes, and re-admission
-            # overwrites the row
-            self.allocator.free(ids)
+            # transactional: a failed admission must not leak its blocks
+            # (fresh allocations AND the references it took on shared
+            # ones). A partially-written table row is harmless — the
+            # decode step trash-routes every INACTIVE slot's writes, and
+            # re-admission overwrites the row
+            if ids is not None:
+                self.allocator.free(ids)
+            if retained:
+                self.allocator.free(hit)
             raise
         self._keys = self._keys.at[slot].set(carry)
         self._temps = self._temps.at[slot].set(float(temperature))
-        self._slot_blocks[slot] = ids
+        self._slot_blocks[slot] = row_blocks
         self._active[slot] = True
         self._last[slot] = first
+        if self.prefix_cache is not None:
+            # index this prompt's full blocks for the next request (insert
+            # skips hashes already present; each new entry takes one
+            # allocator reference so it survives this request's eviction).
+            # `hashes` already covers all n // block_size full blocks —
+            # one chain-hash sweep per admission, reused here
+            full = n // self.block_size
+            self.prefix_cache.insert(hashes, row_blocks[:full])
+            self.prefix_cache.tokens_seen += n
+            self.prefix_cache.tokens_cached += k * self.block_size
         return first
 
     def step(self) -> np.ndarray:

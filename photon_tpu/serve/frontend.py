@@ -65,6 +65,9 @@ class ServeFrontend:
         #: balancers pull the instance), new /generate gets 503 +
         #: Retry-After, in-flight handler threads keep streaming
         self.draining = False
+        #: optional hot-swap watcher (serve/hotswap.py) — attached by the
+        #: CLI so /healthz can report swap counters alongside the round
+        self.watcher: Any | None = None
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> int:
@@ -98,7 +101,7 @@ class ServeFrontend:
                 path = self.path.rstrip("/")
                 if path == "/healthz":
                     eng = fe.batcher.engine
-                    self._json(200, {
+                    payload = {
                         "status": "draining" if fe.draining else "ok",
                         "round": eng.loaded_round,
                         "model": eng.mc.name,
@@ -107,8 +110,15 @@ class ServeFrontend:
                         "queue_depth": fe.batcher.queue_depth,
                         "completed": fe.batcher.completed,
                         "rejected": fe.batcher.rejected,
+                        "swaps": fe.batcher.swaps,
                         "kpis": serve_history_kpis(fe.batcher.history),
-                    })
+                    }
+                    prefix = eng.prefix_stats()
+                    if prefix is not None:
+                        payload["prefix_cache"] = prefix
+                    if fe.watcher is not None:
+                        payload["hotswap"] = fe.watcher.stats()
+                    self._json(200, payload)
                 elif path == "/metrics":
                     # typed instruments (TTFT/TPOT/queue-wait histograms,
                     # HBM gauges, compile counters) + the KPI-History

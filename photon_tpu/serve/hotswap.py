@@ -1,0 +1,231 @@
+"""Round-aware live checkpoint hot-swap (ISSUE 11 tentpole b).
+
+PR 5 closed the train→serve loop ONCE: the daemon loads the latest round
+at startup and serves that frozen snapshot forever. This module makes the
+serving fleet *track* the federated run: a watcher thread polls the run's
+checkpoint directory, validates candidate rounds through the manifest-CRC
+machinery the resume path already trusts, and stages the new params at
+the scheduler's swap point — where admission pauses, running slots finish
+their generations on the old params, and the swap itself is one reference
+assignment (plus a prefix-cache flush: old-param KV is invalid under the
+new round). Zero requests are dropped across a swap; every request runs
+end to end on exactly one round's params.
+
+Defenses, in polling order:
+
+1. **cheap candidate discovery** —
+   ``ServerCheckpointManager.latest_complete_round()``: a manifest-presence
+   scan (no object reads), so an idle daemon polls for pennies and a torn
+   round mid-upload is never even a candidate;
+2. **drain fence** — a poll landing during SIGTERM drain swaps nothing
+   (the dying process must not churn params under in-flight requests);
+3. **federation-health gate** (optional, ``serve.hotswap_statusz_url``) —
+   GET the training run's ``/statusz``; a ``failing`` federation plane
+   (NaN'd aggregate, degraded-round budget blown) means the new rounds
+   are exactly the ones you do NOT want to serve;
+4. **integrity** — ``verify_round`` CRCs every object against the round
+   manifest (memoized per round). A corrupt candidate is skipped with a
+   warning + ``hotswap/skipped`` event + rejected-corrupt counter, and
+   the daemon keeps serving what it has; the store plane goes ``degraded``
+   on /statusz via the health monitor, same as a corrupt round at resume.
+
+The chaos ladder applies unchanged: ``photon.chaos`` store faults bitflip
+candidate-round objects on write, and the e2e (tests/test_hotswap.py)
+pins skip-and-warn-never-swap under exactly that fault.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+import warnings
+
+from photon_tpu import telemetry
+from photon_tpu.serve.engine import load_serving_params
+from photon_tpu.serve.scheduler import ContinuousBatcher, DrainingError
+from photon_tpu.utils.profiling import (
+    EVENT_HOTSWAP_SKIPPED,
+    SERVE_HOTSWAP_REJECTED_CORRUPT,
+)
+
+
+class CheckpointWatcher:
+    """Polls a federated run's checkpoint store and hot-swaps new rounds
+    into a running :class:`~photon_tpu.serve.scheduler.ContinuousBatcher`.
+
+    One watcher per daemon; the thread is named and joined by
+    :meth:`close` (the repo's thread-ownership discipline). ``poll_once``
+    is the whole state machine — tests drive it synchronously, the thread
+    just calls it on a cadence.
+    """
+
+    def __init__(self, batcher: ContinuousBatcher, mgr, cfg, *,
+                 poll_s: float = 5.0, statusz_url: str = "",
+                 swap_timeout_s: float = 120.0) -> None:
+        self.batcher = batcher
+        self.mgr = mgr
+        self.cfg = cfg
+        self.poll_s = poll_s
+        self.statusz_url = statusz_url
+        self.swap_timeout_s = swap_timeout_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # counters for /healthz + tests (the typed-hub twin rides
+        # telemetry.metric_inc at the rejection site)
+        self.swaps_applied = 0
+        self.rejected_corrupt = 0
+        self.polls = 0
+        self.last_outcome = "idle"
+        self._warned_rounds: set[int] = set()  # one warning per bad round
+        # one rejected-corrupt count + health alert per bad round: a run
+        # stalled on a corrupt newest round must not grow the counter and
+        # flood the alert stream once per poll forever
+        self._rejected_rounds: set[int] = set()
+        # a staged-but-unresolved swap: (round, done event). Resolved on
+        # the next poll if the quiesce outlasts swap_timeout_s.
+        self._staged: tuple[int, threading.Event] | None = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "CheckpointWatcher":
+        self._thread = threading.Thread(
+            target=self._loop, name="photon-serve-hotswap", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — a poll must not kill the watcher
+                warnings.warn(
+                    f"hotswap poll failed ({type(e).__name__}: {e}); "
+                    "still serving the current round",
+                    stacklevel=2,
+                )
+                self.last_outcome = "error"
+            self._stop.wait(self.poll_s)
+
+    # -- the state machine ------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            "round": self.batcher.engine.loaded_round,
+            "swaps_applied": self.swaps_applied,
+            "rejected_corrupt": self.rejected_corrupt,
+            "polls": self.polls,
+            "last_outcome": self.last_outcome,
+        }
+
+    def poll_once(self) -> str:
+        """One poll: discover → fence → gate → verify → load → swap.
+        Returns the outcome string (also kept on :attr:`last_outcome`)."""
+        self.last_outcome = self._poll_once()
+        return self.last_outcome
+
+    def _poll_once(self) -> str:
+        self.polls += 1
+        if self._staged is not None:
+            # a previously staged swap is still unresolved (the quiesce
+            # outlasted swap_timeout_s): re-loading params just to hit
+            # request_swap's already-pending error would burn a full
+            # checkpoint read per poll — resolve or keep waiting instead
+            return self._resolve_staged(wait_s=0.0)
+        current = self.batcher.engine.loaded_round
+        candidate = self.mgr.latest_complete_round()
+        if candidate is None or (current is not None and candidate <= current):
+            return "idle"
+        if self.batcher.draining:
+            # SIGTERM fence: a drain in progress outranks tracking the run
+            self._skip(candidate, "draining", warn=False)
+            return "skipped-draining"
+        if self.statusz_url and not self._federation_healthy():
+            self._skip(candidate, "federation-failing")
+            return "skipped-health"
+        if not self.mgr.verify_round(candidate):
+            if candidate not in self._rejected_rounds:
+                # once per bad round, not per poll: verify_round memoizes
+                # the False, and a stalled run must not grow this counter
+                # (or spam store-corruption alerts) every poll_s forever
+                self._rejected_rounds.add(candidate)
+                self.rejected_corrupt += 1
+                telemetry.metric_inc(SERVE_HOTSWAP_REJECTED_CORRUPT)
+                health = telemetry.health_active()
+                if health is not None:
+                    health.note_store_corruption(
+                        round=candidate, run_uuid=self.mgr.run_uuid,
+                        stage="hotswap",
+                    )
+            self._skip(candidate, "corrupt")
+            return "skipped-corrupt"
+        params = load_serving_params(self.cfg, self.mgr, candidate)
+        try:
+            done = self.batcher.request_swap(params, loaded_round=candidate)
+        except DrainingError:
+            self._skip(candidate, "draining", warn=False)
+            return "skipped-draining"
+        self._staged = (candidate, done)
+        return self._resolve_staged(wait_s=self.swap_timeout_s)
+
+    def _resolve_staged(self, wait_s: float) -> str:
+        """Resolve the staged swap: applied → ``swapped`` (counted exactly
+        once, even when the quiesce outlasted an earlier poll's wait),
+        still quiescing → ``pending``, dropped by the batcher (drain/stop
+        abandoned it) → ``swap-abandoned``."""
+        rnd, done = self._staged
+        if wait_s > 0:
+            # stop-aware wait: a SIGTERM closing the watcher mid-quiesce
+            # must not park close()'s join behind a 120s done.wait — the
+            # drain path is what abandons the staged swap and fires done
+            deadline = time.monotonic() + wait_s
+            while (not done.is_set() and not self._stop.is_set()
+                   and time.monotonic() < deadline):
+                done.wait(0.2)
+        if self.batcher.engine.loaded_round == rnd:
+            self._staged = None
+            self.swaps_applied += 1
+            return "swapped"
+        if not done.is_set():
+            return "pending"  # still quiescing; next poll re-resolves
+        self._staged = None
+        return "swap-abandoned"
+
+    def _skip(self, candidate: int, reason: str, warn: bool = True) -> None:
+        telemetry.emit_event(EVENT_HOTSWAP_SKIPPED, round=candidate,
+                             reason=reason)
+        if warn and candidate not in self._warned_rounds:
+            self._warned_rounds.add(candidate)
+            warnings.warn(
+                f"hotswap: skipping candidate round {candidate} ({reason}); "
+                f"still serving round {self.batcher.engine.loaded_round}",
+                stacklevel=2,
+            )
+
+    def _federation_healthy(self) -> bool:
+        """GET the training run's /statusz; False exactly when it answers
+        and reports the federation plane ``failing`` (don't track a
+        failing run). Unreachable/garbage answers fail OPEN — an absent
+        observability endpoint must not freeze the serving fleet on a
+        stale round forever."""
+        try:
+            with urllib.request.urlopen(self.statusz_url, timeout=5.0) as r:
+                payload = json.loads(r.read().decode())
+            if not isinstance(payload, dict):
+                return True  # valid JSON, wrong shape (misrouted URL)
+            plane = payload.get("planes", {})
+            if not isinstance(plane, dict):
+                return True
+            plane = plane.get("federation", {})
+            return not (isinstance(plane, dict)
+                        and plane.get("status") == "failing")
+        except (OSError, ValueError, TypeError, AttributeError):
+            # fail OPEN on any malformed answer, not just unreachable —
+            # a garbage endpoint must not freeze the fleet on a stale round
+            return True

@@ -1,0 +1,195 @@
+"""Content-addressed prefix reuse: share prompt-prefix KV blocks across
+requests (ISSUE 11 tentpole a).
+
+Millions of users hitting the same system prompt / few-shot template pay
+the same prefill over and over — and prefill is the dominant serving cost
+at scale. The per-slot block-table indirection (``serve/cache.py``) makes
+cross-request sharing a bookkeeping change, not a kernel change: a KV
+block is just a physical pool id, and nothing stops two slots' tables
+from pointing at the same one as long as neither ever writes it.
+
+Three pieces:
+
+- **chain hashes** (:func:`prefix_hashes`) — block ``j``'s key is
+  ``blake2b(hash_{j-1} || tokens[j*bs:(j+1)*bs])``, so a hash identifies
+  the WHOLE prefix up through block ``j``, not one block's contents. Two
+  prompts that differ anywhere before block ``j`` can never collide into
+  sharing block ``j``.
+- **refcounts** (``BlockAllocator.retain/free``, serve/cache.py) — a
+  shared block is held once per slot mapping it plus once by this cache;
+  only the last reference returns it to the free list.
+- **the LRU** (:class:`PrefixCache`) — hash → physical block, insertion
+  holds one allocator reference so a finished request's prefix blocks
+  survive for the next request. Eviction (capacity pressure via
+  :meth:`ensure_free`, an explicit cap, or a flush) drops ONLY the
+  cache's reference: an entry evicted while a live request still maps its
+  block (``LRU-evict-while-pinned``) just un-indexes it — the block frees
+  when that request evicts.
+
+Copy-on-write invariant: a cached block is full (entirely covered by
+prompt tokens) and the decode cursor of every request mapping it starts
+strictly past it, so shared blocks are **never written** — the first
+block a request may write (its partial tail, or the block its first
+generated token lands in) is always freshly allocated. The engine caps
+lookups at ``(len(prompt) - 1) // block_size`` blocks so the suffix
+always retains at least the final prompt token: its forward pass is what
+produces the first sampled token's logits.
+
+A parameter hot-swap flushes the cache wholesale (``serve/hotswap.py``):
+KV computed under the old round's params is invalid under the new one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def prefix_hashes(prompt: list[int], block_size: int,
+                  limit: int | None = None) -> list[bytes]:
+    """Chain hashes for ``prompt``'s full blocks, most-significant first:
+    ``out[j]`` identifies tokens ``[0, (j+1) * block_size)``. ``limit``
+    caps the number of blocks hashed (the engine passes
+    ``(len(prompt) - 1) // block_size`` so the final prompt token is never
+    cache-resolved away)."""
+    n_full = len(prompt) // block_size
+    if limit is not None:
+        n_full = min(n_full, limit)
+    out: list[bytes] = []
+    prev = b""
+    for j in range(n_full):
+        block = np.asarray(
+            prompt[j * block_size:(j + 1) * block_size], np.int32
+        ).tobytes()
+        prev = hashlib.blake2b(prev + block, digest_size=16).digest()
+        out.append(prev)
+    return out
+
+
+class PrefixCache:
+    """LRU of hashed, allocator-referenced KV blocks.
+
+    Single-driver-thread discipline (the scheduler loop owns admission and
+    eviction, same as :class:`~photon_tpu.serve.engine.PagedEngine`): no
+    internal locking. ``max_blocks = 0`` means no explicit cap — the cache
+    is still bounded by the pool, because :meth:`ensure_free` evicts under
+    allocation pressure.
+    """
+
+    def __init__(self, allocator, max_blocks: int = 0) -> None:
+        self.allocator = allocator
+        self.max_blocks = max_blocks
+        self._entries: dict[bytes, int] = {}  # insertion order == LRU order
+        # cumulative stats (the scheduler's tick mirrors these into the
+        # serve/prefix_* instruments)
+        self.evictions = 0
+        self.tokens_cached = 0  # prompt tokens whose prefill was skipped
+        self.tokens_seen = 0  # all submitted prompt tokens
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def hit_rate(self) -> float:
+        """Cumulative cached-token fraction over all submitted prompts."""
+        return self.tokens_cached / self.tokens_seen if self.tokens_seen else 0.0
+
+    def lookup(self, hashes: list[bytes], touch: bool = True) -> list[int]:
+        """Physical blocks of the longest cached prefix of ``hashes``
+        (chain hashing makes any gap a hard stop). Touches hits to MRU
+        unless ``touch=False`` (the admissibility predicate peeks without
+        reshuffling eviction order — a capacity-blocked queue head re-peeks
+        every scheduler tick). Takes NO references — the caller retains
+        before anything (its own ``ensure_free``, or another admission)
+        could evict them."""
+        out: list[int] = []
+        for h in hashes:
+            block = self._entries.get(h)
+            if block is None:
+                break
+            if touch:
+                # dict preserves insertion order: delete+reinsert = move-to-end
+                del self._entries[h]
+                self._entries[h] = block
+            out.append(block)
+        return out
+
+    def insert(self, hashes: list[bytes], blocks: list[int]) -> int:
+        """Index ``blocks[j]`` (the slot's physical block ``j``) under
+        ``hashes[j]``, taking one allocator reference per NEWLY indexed
+        block; already-present hashes are skipped (their earlier block
+        stays the canonical copy). Returns how many entries were added."""
+        added = 0
+        for h, block in zip(hashes, blocks):
+            if h in self._entries:
+                continue
+            if self.max_blocks and len(self._entries) >= self.max_blocks:
+                self._evict_for_cap()
+            self.allocator.retain([block])
+            self._entries[h] = block
+            added += 1
+        return added
+
+    def _evict_for_cap(self) -> None:
+        """Cap-pressure victim: the LRU-oldest UNPINNED entry when one
+        exists — un-indexing a pinned entry frees no blocks and tears a
+        live hot prefix's chain (any gap = total miss) for nothing. With
+        every entry pinned, the plain LRU head goes (the index bound must
+        hold regardless)."""
+        h = next((h for h, b in self._entries.items()
+                  if self.allocator.refcount(b) == 1), None)
+        if h is None:
+            h = next(iter(self._entries))
+        block = self._entries.pop(h)
+        self.evictions += 1
+        self.allocator.free([block])
+
+    def _evict_lru(self) -> None:
+        h = next(iter(self._entries))
+        block = self._entries.pop(h)
+        self.evictions += 1
+        # dropping the CACHE's reference only: a block still mapped by a
+        # live slot survives until that request evicts (the
+        # evict-while-pinned edge the tests pin)
+        self.allocator.free([block])
+
+    def reclaimable(self, exclude: set[int] | None = None) -> int:
+        """Entries only this cache references (refcount 1): evicting them
+        actually returns blocks to the free list. ``exclude`` = blocks an
+        admission is about to retain (evicting those yields nothing). The
+        single owner of the evictability predicate — ``ensure_free`` and
+        the engine's admissibility math must agree on it."""
+        exclude = exclude or set()
+        return sum(
+            1 for b in self._entries.values()
+            if b not in exclude and self.allocator.refcount(b) == 1
+        )
+
+    def ensure_free(self, n: int) -> bool:
+        """Evict entries, LRU first, until the allocator can cover ``n``
+        blocks. ONLY unpinned entries (refcount 1 — the cache is the sole
+        holder) are considered: evicting an entry a live slot still maps
+        frees no pool capacity and would destroy a hot prefix's index for
+        nothing. Pinned entries stay indexed; their blocks become
+        reclaimable the moment their last request evicts."""
+        if self.allocator.free_blocks >= n:
+            return True
+        evictable = [h for h, b in self._entries.items()
+                     if self.allocator.refcount(b) == 1]
+        for h in evictable:
+            if self.allocator.free_blocks >= n:
+                break
+            block = self._entries.pop(h)
+            self.evictions += 1
+            self.allocator.free([block])
+        return self.allocator.free_blocks >= n
+
+    def flush(self) -> int:
+        """Drop every entry (hot-swap: old-param KV is invalid under the
+        new round). Returns the number of entries dropped."""
+        dropped = 0
+        while self._entries:
+            self._evict_lru()
+            dropped += 1
+        return dropped

@@ -40,12 +40,21 @@ from photon_tpu.analysis.runtime import steady_point
 from photon_tpu.metrics.history import History
 from photon_tpu.serve.engine import PagedEngine
 from photon_tpu.utils.profiling import (
+    EVENT_HOTSWAP_SWAPPED,
     SERVE_COMPILES_TOTAL,
     SERVE_DECODE_SPAN,
     SERVE_EVICTIONS,
     SERVE_HBM_BYTES_IN_USE,
     SERVE_HBM_PEAK_BYTES,
+    SERVE_HOTSWAP_ROUND,
+    SERVE_HOTSWAP_SWAP_LATENCY_S,
+    SERVE_HOTSWAP_SWAP_SPAN,
+    SERVE_HOTSWAP_SWAPS_TOTAL,
     SERVE_PREFILL_SPAN,
+    SERVE_PREFIX_EVICTIONS,
+    SERVE_PREFIX_HIT_RATE,
+    SERVE_PREFIX_SHARED_BLOCKS,
+    SERVE_PREFIX_TOKENS_CACHED,
     SERVE_QUEUE_DEPTH,
     SERVE_QUEUE_SPAN,
     SERVE_QUEUE_WAIT_S,
@@ -141,6 +150,11 @@ class ContinuousBatcher:
         self.rejected = 0
         self.evictions = 0
         self.completed = 0
+        self.swaps = 0
+        # live checkpoint hot-swap (ISSUE 11): (params, round, done-event,
+        # t_request) staged by request_swap, applied by the driver thread
+        # at the swap point — between decode steps, with zero active slots
+        self._pending_swap: tuple | None = None
         # FIFO-audit ring (tests assert order); bounded — a serving daemon
         # must not grow per-request state forever
         self.admitted_order: deque[int] = deque(maxlen=4096)
@@ -183,7 +197,15 @@ class ContinuousBatcher:
         when the drain completed with zero dropped requests."""
         with self._work:
             self._draining = True
+            # a swap staged just before the drain is ABANDONED, not applied:
+            # applying would churn params under in-flight requests, while
+            # leaving it staged would keep admission paused and starve the
+            # queued requests the drain promises to finish. The watcher's
+            # waiter unblocks and sees the round unchanged.
+            pending, self._pending_swap = self._pending_swap, None
             self._work.notify_all()
+        if pending is not None:
+            pending[2].set()
         deadline = time.monotonic() + timeout_s
         drained = False
         while time.monotonic() < deadline:
@@ -194,6 +216,69 @@ class ContinuousBatcher:
             time.sleep(0.01)
         self.close()
         return drained
+
+    # -- live checkpoint hot-swap (ISSUE 11) ------------------------------
+    def request_swap(self, params, loaded_round: int | None = None
+                     ) -> threading.Event:
+        """Stage a parameter swap; returns an Event set once the driver
+        thread has applied it. Ordering guarantees (docs/serving.md):
+        admission pauses (queued/new requests wait — nothing is dropped),
+        running slots finish their generations on the OLD params, then the
+        swap is one reference assignment and the prefix cache flushes. A
+        draining/stopped batcher refuses (:class:`DrainingError`) — the
+        watcher retries after the drain decision is final."""
+        with self._work:
+            if self._stop or self._draining:
+                raise DrainingError("batcher draining/stopped: swap refused")
+            if self._pending_swap is not None:
+                raise RuntimeError("a param swap is already pending")
+            done = threading.Event()
+            self._pending_swap = (params, loaded_round, done, time.monotonic())
+            self._work.notify_all()
+        return done
+
+    @property
+    def swap_pending(self) -> bool:
+        with self._lock:
+            return self._pending_swap is not None
+
+    def _maybe_swap(self) -> None:
+        """The swap point: driver thread only, between decode steps. Fires
+        exactly when a swap is staged and no slot is active (admission is
+        paused while one is staged, so the engine quiesces in at most the
+        longest running request's remaining steps)."""
+        with self._lock:
+            if self._pending_swap is None or self._running:
+                return
+            # CLAIM the swap under the lock: a drain() racing in after this
+            # point finds nothing to abandon, so exactly one of {apply,
+            # abandon} ever happens and done fires exactly once
+            params, rnd, done, t0 = self._pending_swap
+            self._pending_swap = None
+        try:
+            self.engine.set_params(params, loaded_round=rnd)
+        except BaseException:
+            # a failed apply must still release the waiter (it observes the
+            # unchanged round and reports the abandon) — otherwise the
+            # watcher wedges in 'pending' forever. The re-raise reaches the
+            # loop's handler, which fails in-flight requests loudly (the
+            # engine's param state is unknown after a partial swap).
+            done.set()
+            raise
+        latency = time.monotonic() - t0
+        with self._lock:
+            self.swaps += 1
+        tr = telemetry.active()
+        if tr is not None:
+            tr.add_span(SERVE_HOTSWAP_SWAP_SPAN, time.time() - latency,
+                        latency, round=-1 if rnd is None else int(rnd))
+        telemetry.metric_observe(SERVE_HOTSWAP_SWAP_LATENCY_S, latency)
+        telemetry.emit_event(
+            EVENT_HOTSWAP_SWAPPED,
+            round=-1 if rnd is None else int(rnd),
+            latency_s=round(latency, 6),
+        )
+        done.set()
 
     # -- submission (any thread) ------------------------------------------
     def submit(self, prompt: list[int], max_new_tokens: int, *,
@@ -238,22 +323,37 @@ class ContinuousBatcher:
 
     def stats(self) -> dict[str, float]:
         with self._lock:
-            return {
+            out = {
                 SERVE_QUEUE_DEPTH: float(len(self._queue)),
                 SERVE_SLOT_OCCUPANCY: len(self._running) / self.engine.n_slots,
                 SERVE_EVICTIONS: float(self.evictions),
                 SERVE_REJECTED: float(self.rejected),
+                SERVE_HOTSWAP_SWAPS_TOTAL: float(self.swaps),
             }
+            # getattr: fake/minimal engines (tests, alternative backends)
+            # need not carry the checkpoint- or prefix-plane attributes
+            rnd = getattr(self.engine, "loaded_round", None)
+            if rnd is not None:
+                out[SERVE_HOTSWAP_ROUND] = float(rnd)
+        pc = getattr(self.engine, "prefix_cache", None)
+        if pc is not None:
+            out[SERVE_PREFIX_HIT_RATE] = pc.hit_rate
+            out[SERVE_PREFIX_SHARED_BLOCKS] = float(len(pc))
+            out[SERVE_PREFIX_EVICTIONS] = float(pc.evictions)
+            out[SERVE_PREFIX_TOKENS_CACHED] = float(pc.tokens_cached)
+        return out
 
     # -- driver loop -------------------------------------------------------
     def _loop(self) -> None:
         while True:
             with self._work:
-                while not self._stop and not self._queue and not self._running:
+                while (not self._stop and not self._queue
+                       and not self._running and self._pending_swap is None):
                     self._work.wait(timeout=0.5)
                 if self._stop:
                     break
             try:
+                self._maybe_swap()
                 self._admit_phase()
                 self._decode_phase()
             except Exception as e:  # noqa: BLE001 — fail loudly, not silently
@@ -270,6 +370,11 @@ class ContinuousBatcher:
         self._drain_on_stop()
 
     def _admit_phase(self) -> None:
+        if self.swap_pending:
+            # quiesce toward the swap point: nothing new starts on params
+            # about to be replaced; queued requests wait (never dropped)
+            # and running slots drain through the decode phase
+            return
         budget = self.prefill_token_budget
         admitted_any = False
         # batch-sync baseline: a wave may only START from an empty engine,
@@ -288,7 +393,7 @@ class ContinuousBatcher:
                 return  # interleave: give decode a turn before more prefills
             slot = self.engine.free_slot()
             if slot is None or not self.engine.can_admit(
-                len(head.prompt), head.max_new_tokens
+                len(head.prompt), head.max_new_tokens, prompt=head.prompt
             ):
                 return  # FIFO head-blocking: nobody overtakes
             with self._lock:
@@ -371,6 +476,11 @@ class ContinuousBatcher:
         with self._lock:
             queued, self._queue = list(self._queue), deque()
             running = list(self._running.items())
+            # a swap the stopped loop will never apply: unblock its waiter
+            # (it observes the unchanged round and reports the abandon)
+            pending, self._pending_swap = self._pending_swap, None
+        if pending is not None:
+            pending[2].set()
         for slot, req in running:
             self._finish(slot, req, error="server shutting down")
         for req in queued:
@@ -391,6 +501,19 @@ class ContinuousBatcher:
             hub.gauge(SERVE_SLOT_OCCUPANCY).set(stats[SERVE_SLOT_OCCUPANCY])
             hub.counter(SERVE_EVICTIONS).inc_to(stats[SERVE_EVICTIONS])
             hub.counter(SERVE_REJECTED).inc_to(stats[SERVE_REJECTED])
+            hub.counter(SERVE_HOTSWAP_SWAPS_TOTAL).inc_to(
+                stats[SERVE_HOTSWAP_SWAPS_TOTAL])
+            if SERVE_HOTSWAP_ROUND in stats:
+                hub.gauge(SERVE_HOTSWAP_ROUND).set(stats[SERVE_HOTSWAP_ROUND])
+            if SERVE_PREFIX_HIT_RATE in stats:
+                hub.gauge(SERVE_PREFIX_HIT_RATE).set(
+                    stats[SERVE_PREFIX_HIT_RATE])
+                hub.gauge(SERVE_PREFIX_SHARED_BLOCKS).set(
+                    stats[SERVE_PREFIX_SHARED_BLOCKS])
+                hub.counter(SERVE_PREFIX_EVICTIONS).inc_to(
+                    stats[SERVE_PREFIX_EVICTIONS])
+                hub.counter(SERVE_PREFIX_TOKENS_CACHED).inc_to(
+                    stats[SERVE_PREFIX_TOKENS_CACHED])
             if (self._tick - 1) % self.device_sample_ticks == 0:
                 # HBM live/peak + backend compiles, sampled sparsely — a
                 # per-tick memory_stats() call would tax the decode cadence
@@ -472,6 +595,8 @@ def serve_history_kpis(history: History) -> dict[str, float]:
     return {
         k: v
         for k in (SERVE_TTFT_S, SERVE_TOKENS_PER_S, SERVE_QUEUE_DEPTH,
-                  SERVE_SLOT_OCCUPANCY, SERVE_EVICTIONS, SERVE_REJECTED)
+                  SERVE_SLOT_OCCUPANCY, SERVE_EVICTIONS, SERVE_REJECTED,
+                  SERVE_HOTSWAP_SWAPS_TOTAL, SERVE_HOTSWAP_ROUND,
+                  SERVE_PREFIX_HIT_RATE, SERVE_PREFIX_SHARED_BLOCKS)
         if (v := history.latest(k)) is not None
     }

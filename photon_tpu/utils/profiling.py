@@ -148,6 +148,33 @@ SERVE_QUEUE_SPAN = "serve/queue"
 SERVE_PREFILL_SPAN = "serve/prefill"
 SERVE_DECODE_SPAN = "serve/decode"
 
+# -- multi-tenant serving daemon (ISSUE 11, serve/prefix.py + hotswap.py) --
+# Content-addressed prefix cache (tick-time gauges/counters recorded into
+# the batcher History AND mirrored onto the typed hub):
+#: fraction of cumulative prompt tokens served out of the prefix cache
+#: (cached full-block tokens / all submitted prompt tokens)
+SERVE_PREFIX_HIT_RATE = "serve/prefix_hit_rate"
+#: physical blocks currently indexed by the prefix cache (each holds one
+#: allocator reference; shared CoW blocks in live use count here too)
+SERVE_PREFIX_SHARED_BLOCKS = "serve/prefix_shared_blocks"
+#: cumulative cache entries dropped (LRU pressure + explicit flushes)
+SERVE_PREFIX_EVICTIONS = "serve/prefix_evictions"
+#: cumulative prompt tokens whose prefill was skipped via a cache hit
+SERVE_PREFIX_TOKENS_CACHED = "serve/prefix_tokens_cached_total"
+# Live checkpoint hot-swap (serve/hotswap.py watcher + scheduler swap point):
+#: cumulative parameter swaps applied at the scheduler swap point
+SERVE_HOTSWAP_SWAPS_TOTAL = "serve/hotswap_swaps_total"
+#: seconds from swap request to the reference assignment landing (the
+#: quiesce window: running slots finishing on the old params)
+SERVE_HOTSWAP_SWAP_LATENCY_S = "serve/hotswap_swap_latency_s"
+#: candidate rounds the watcher refused because their manifest checksums
+#: failed (the corrupt round is skipped-and-warned, never swapped)
+SERVE_HOTSWAP_REJECTED_CORRUPT = "serve/hotswap_rejected_corrupt_total"
+#: the server round currently being served (moves on a successful swap)
+SERVE_HOTSWAP_ROUND = "serve/hotswap_round"
+# span-only: the swap window (request → reference assignment)
+SERVE_HOTSWAP_SWAP_SPAN = "serve/hotswap_swap"
+
 # -- run-health observatory instruments (ISSUE 10, telemetry/metrics.py) --
 # Histogram instruments on the serve plane (typed-metric hub, NOT History
 # KPIs: a latest-value gauge can't show a distribution):
@@ -203,6 +230,11 @@ EVENT_COLLECTIVE_DEGRADED = "collective/degraded"
 #: fault-injector firings are ``chaos/<plan kind>`` (chaos/injector.py
 #: counters: tcp_drop, store_bitflip, crash, ...)
 CHAOS_EVENT_PREFIX = "chaos/"
+#: the hot-swap watcher applied a new round's params (ISSUE 11)
+EVENT_HOTSWAP_SWAPPED = "hotswap/swapped"
+#: the watcher skipped a candidate round (corrupt manifest, failing
+#: federation health, or a poll landing during drain) — attrs say which
+EVENT_HOTSWAP_SKIPPED = "hotswap/skipped"
 
 # -- structured alert kinds (telemetry/health.py, ISSUE 10) ---------------
 # Health watchers emit these as events (same registry discipline) AND

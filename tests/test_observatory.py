@@ -734,7 +734,7 @@ class FakeEngine:
     def fits(self, n_prompt, max_new):
         return True
 
-    def can_admit(self, n_prompt, max_new):
+    def can_admit(self, n_prompt, max_new, prompt=None):
         return True
 
     def free_slot(self):
