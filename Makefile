@@ -1,7 +1,7 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test test-all bench bench-host bench-telemetry bench-collective bench-compare chaos chaos-collective telemetry-smoke serve-smoke lint lint-tests native clean
+.PHONY: test test-all bench bench-host bench-telemetry bench-collective bench-ragged bench-compare chaos chaos-collective telemetry-smoke serve-smoke lint lint-tests native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
@@ -32,6 +32,13 @@ bench-telemetry:
 # asserts the >=3.5x modeled cross-slice byte reduction at q8
 bench-collective:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --collective
+
+# ragged-paged-attention serving gate (ISSUE 12): tokens/s vs live-KV
+# fraction (ragged walk vs the PR 5 full-width gather — ragged must win
+# at low occupancy) plus the chunked-vs-interleaved worst-decode-gap
+# ratio. Lint preflight like the other smoke targets.
+bench-ragged: lint
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --ragged
 
 # bench regression gate (ISSUE 10): diff two BENCH_r*.json artifacts'
 # shared report keys; exit nonzero on a >15% regression in train
@@ -65,23 +72,26 @@ lint-tests:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_analysis.py -q
 
-# serving smoke (ISSUE 5 + 11): the whole serving-plane suite — paged-cache
-# bit-parity with the contiguous decoder, scheduler invariants, HTTP
-# round-trips (blocking + chunked streaming) against a real round
-# checkpoint, the content-addressed prefix cache (refcounts, chain hashes,
-# cached-vs-cold per-step bit-parity, LRU pressure) and the live
-# checkpoint hot-swap (watcher state machine incl. the chaos
-# corrupt-candidate skip, zero-dropped-across-swap e2e) — then the serving
-# bench, whose exit code asserts continuous batching beats batch-sync at
-# 16 concurrent, the prefix cache cuts mean TTFT at 90% shared-prefix
-# traffic, and a live swap drops zero requests. All of it rides tier-1
-# too (none is slow). photon-lint preflight first: a rule regression (or
-# a fresh violation in serve/) fails the smoke before any engine compile
-# burns minutes
+# serving smoke (ISSUE 5 + 11 + 12): the whole serving-plane suite —
+# mixed-step bit-parity with the contiguous decoder, the ragged
+# paged-attention kernel's epsilon tier, scheduler invariants incl.
+# decode cadence under a 4x-budget chunked prompt, HTTP round-trips
+# (blocking + chunked streaming) against a real round checkpoint, the
+# content-addressed prefix cache (refcounts, chain hashes, cached-vs-cold
+# per-step bit-parity, LRU pressure) and the live checkpoint hot-swap
+# (watcher state machine incl. the chaos corrupt-candidate skip,
+# zero-dropped-across-swap e2e) — then the serving bench, whose exit code
+# asserts continuous batching beats batch-sync at 16 concurrent, the
+# prefix cache cuts mean TTFT at 90% shared-prefix traffic, a live swap
+# drops zero requests, ragged attention beats the full-width gather at
+# low pool occupancy, and chunked prefill cuts the worst decode gap. All
+# of it rides tier-1 too (none is slow). photon-lint preflight first: a
+# rule regression (or a fresh violation in serve/) fails the smoke before
+# any engine compile burns minutes
 serve-smoke: lint
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_serve.py tests/test_serve_prefix.py tests/test_hotswap.py \
-		-q -m "slow or not slow"
+		tests/test_ragged_attention.py -q -m "slow or not slow"
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --serving
 
 # the chaos-marked fault-injection + elasticity suite (incl. the slow

@@ -1821,6 +1821,163 @@ def hotswap_live_report(n_requests: int = 24, seed: int = 0) -> dict | None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def ragged_serving_report(occupancies=(0.1, 0.5, 0.9), n_slots: int = 4,
+                          seed: int = 0) -> dict | None:
+    """Ragged paged attention vs the full-width dense gather (ISSUE 12):
+    the tokens/s-vs-live-KV-fraction curve, plus chunked-prefill TPOT
+    protection.
+
+    **Occupancy curve.** The PR 5 gather attends every slot at FULL
+    padded width, so decode cost scales with pool capacity; the ragged
+    walk attends at the live width. Traffic at ~10% / 50% / 90% pool
+    occupancy (per-slot live length ≈ frac x slot capacity; same
+    prompts, same greedy tokens, only ``serve.attention_impl`` differs)
+    shows the win exactly where the theory says: large at low occupancy,
+    converging to parity as live length approaches capacity. A FRESH
+    ragged engine per occupancy point keeps the monotone live-width
+    high-water honest (a shared engine would bill every point at the
+    biggest point's width). ABBA-ordered best-of-2 per (frac, impl); the
+    low-occupancy speedup is the exit-code gate.
+
+    **Chunked-vs-interleaved TPOT.** One in-flight decode request, then a
+    prompt 4x the chunk budget arrives. Interleaved (the PR 5 shape:
+    whole prompt in one program — emulated as budget >= prompt) stalls
+    the decode for the entire prefill; chunked splits it, decode rows
+    riding every step. Driven synchronously (the test-owned driver
+    phases), the metric is the decode stream's MAX inter-token gap
+    during the prompt's admission; the chunked/interleaved gap ratio
+    must exceed 1 (gate)."""
+    try:
+        import numpy as np
+
+        from photon_tpu.config.schema import Config
+        from photon_tpu.models.mpt import init_params
+        from photon_tpu.serve.engine import PagedEngine
+        from photon_tpu.serve.scheduler import ContinuousBatcher
+
+        def mk_cfg(attn: str, budget: int = 2048) -> Config:
+            cfg = Config()
+            cfg.model.d_model = 64
+            cfg.model.n_layers = 2
+            cfg.model.n_heads = 4
+            # a LONG slot capacity: the gather's full-width cost is what
+            # the curve measures, and a short context would bury it under
+            # the (shared) mlp/logits/dispatch cost on the CPU sandbox
+            cfg.model.max_seq_len = 512
+            cfg.model.vocab_size = 64
+            cfg.model.attn_impl = "xla"
+            cfg.model.compute_dtype = "float32"
+            cfg.photon.serve.n_slots = n_slots
+            cfg.photon.serve.block_size = 8
+            cfg.photon.serve.max_new_tokens = 32
+            cfg.photon.serve.attention_impl = attn
+            cfg.photon.serve.prefill_token_budget = budget
+            return cfg.validate()
+
+        params = init_params(mk_cfg("auto").model, seed=4)
+        rng = np.random.default_rng(seed)
+        s_cap = 512
+        max_new = 24
+
+        def run_point(engine, requests) -> dict:
+            batcher = ContinuousBatcher(engine, max_queue=n_slots + 1).start()
+            try:
+                t0 = time.perf_counter()
+                reqs = [batcher.submit(p, n) for p, n in requests]
+                outs = [r.result(timeout=600) for r in reqs]
+                wall = time.perf_counter() - t0
+            finally:
+                batcher.close()
+            tokens = sum(len(o) for o in outs)
+            return {"tokens_per_s": round(tokens / wall, 2),
+                    "wall_s": round(wall, 4)}
+
+        out: dict = {"n_slots": n_slots, "s_cap": s_cap, "occupancy": {}}
+        for frac in occupancies:
+            p_len = max(4, int(round(frac * s_cap)) - max_new)
+            requests = [
+                (list(map(int, rng.integers(1, 64, p_len))), max_new)
+                for _ in range(n_slots)
+            ]
+            engines = {
+                "ragged": PagedEngine(mk_cfg("auto"), params),
+                "gather": PagedEngine(mk_cfg("gather"), params),
+            }
+            for eng in engines.values():  # warmup: compiles + ragged hw
+                run_point(eng, requests)
+            runs = {"ragged": [], "gather": []}
+            for impl in ("ragged", "gather", "gather", "ragged"):
+                runs[impl].append(run_point(engines[impl], requests))
+            best = {m: min(rs, key=lambda r: r["wall_s"])
+                    for m, rs in runs.items()}
+            eng = engines["ragged"]
+            best["live_frac"] = round(
+                n_slots * eng.blocks_needed(p_len, max_new) / eng.n_blocks, 4)
+            best["ctx_blocks"] = int(eng.attn_stats()["ctx_blocks"])
+            best["speedup"] = (
+                round(best["ragged"]["tokens_per_s"]
+                      / best["gather"]["tokens_per_s"], 3)
+                if best["gather"]["tokens_per_s"] else None
+            )
+            out["occupancy"][str(frac)] = best
+        low = out["occupancy"][str(min(occupancies))]
+        out["low_occupancy_speedup"] = low["speedup"]
+
+        # -- chunked vs interleaved TPOT under a 4x-budget prompt --------
+        budget = 48
+        giant_len = 4 * budget
+
+        def tpot_mode(mode_budget: int) -> dict:
+            cfg = mk_cfg("auto", budget=mode_budget)
+            engine = PagedEngine(cfg, params)
+            gaps = []
+            for attempt in range(2):  # attempt 0 warms every compile
+                batcher = ContinuousBatcher(
+                    engine, max_queue=4, prefill_token_budget=mode_budget)
+                dec = batcher.submit([5, 9, 2, 7], 30)
+                batcher._admit_phase()
+                while engine.pending_tokens(0) > 0:
+                    batcher._step_phase()
+                giant = list(map(int, rng.integers(1, 64, giant_len)))
+                big = batcher.submit(giant, 2)
+                batcher._admit_phase()
+                max_gap, last = 0.0, time.perf_counter()
+                while not big.generated:
+                    before = len(dec.generated)
+                    batcher._step_phase()
+                    now = time.perf_counter()
+                    if len(dec.generated) > before:
+                        max_gap = max(max_gap, now - last)
+                        last = now
+                    elif not dec.finished:
+                        max_gap = max(max_gap, now - last)
+                while not (dec.finished and big.finished):
+                    batcher._step_phase()
+                batcher.close()
+                if attempt:
+                    gaps.append(max_gap)
+            return {"max_decode_gap_s": round(min(gaps), 5)}
+
+        chunked = tpot_mode(budget)
+        interleaved = tpot_mode(giant_len)  # whole prompt in one chunk
+        ratio = (
+            round(interleaved["max_decode_gap_s"]
+                  / chunked["max_decode_gap_s"], 3)
+            if chunked["max_decode_gap_s"] else None
+        )
+        out["chunked_tpot"] = {
+            "prompt_tokens": giant_len,
+            "chunk_budget": budget,
+            "chunked": chunked,
+            "interleaved": interleaved,
+            "gap_ratio": ratio,
+        }
+        return out
+    except Exception as e:  # noqa: BLE001 — never cost the round its numbers
+        log(f"ragged serving report failed: {type(e).__name__}: {e}")
+        return None
+
+
 # ---------------------------------------------------------------------------
 # Device-collective aggregation plane (ISSUE 7; lands in the BENCH_*.json)
 # ---------------------------------------------------------------------------
@@ -2012,11 +2169,25 @@ def _serving_tps(parsed: dict) -> float | None:
     return _dig(conc, (k, "continuous", "tokens_per_s"))
 
 
+def _ragged_low_occ_tps(parsed: dict) -> float | None:
+    """Ragged-walk tokens/s at the occupancy curve's LOWEST point (the
+    regime the ragged kernel exists for)."""
+    occ = parsed.get("serving_ragged", {}).get("occupancy")
+    if not isinstance(occ, dict) or not occ:
+        return None
+    try:
+        k = min(occ, key=lambda s: float(s))
+    except ValueError:
+        return None
+    return _dig(occ, (k, "ragged", "tokens_per_s"))
+
+
 #: gated headline numbers, (extractor, label, platform_sensitive). Higher
-#: is better for both; a drop past the threshold exits nonzero.
+#: is better for all; a drop past the threshold exits nonzero.
 _COMPARE_GATES = (
     (lambda p: _dig(p, ("value",)), "train_tokens_per_sec", True),
     (_serving_tps, "serving_tokens_per_s", False),
+    (_ragged_low_occ_tps, "serving_ragged_low_occ_tokens_per_s", False),
 )
 
 
@@ -2501,6 +2672,12 @@ def run(platform: str) -> None:
         if hs is not None:
             out["serving_hotswap"] = hs
             emit(out)
+        # ragged paged attention (ISSUE 12): the tokens/s-vs-live-KV
+        # curve (ragged walk vs full-width gather) + chunked-prefill TPOT
+        rg = ragged_serving_report()
+        if rg is not None:
+            out["serving_ragged"] = rg
+            emit(out)
 
     # device-collective aggregation plane (own child interpreter — the
     # emulated 8-device CPU mesh must exist before jax initializes): flat
@@ -2641,6 +2818,13 @@ def main() -> int:
                          "vs batch-synchronous, tiny CPU model) and print "
                          "{'serving': ...}; exits nonzero unless continuous "
                          "batching wins at max concurrency")
+    ap.add_argument("--ragged", action="store_true",
+                    help="run only the ragged-paged-attention serving report "
+                         "(tokens/s vs live-KV fraction, ragged walk vs "
+                         "full-width gather, plus chunked-vs-interleaved "
+                         "TPOT) and print {'serving_ragged': ...}; exits "
+                         "nonzero unless ragged wins at low occupancy and "
+                         "chunking cuts the worst decode gap")
     ap.add_argument("--collective", action="store_true",
                     help="run only the device-collective aggregation report "
                          "(flat fp32 vs hierarchical q8 on an emulated CPU "
@@ -2672,18 +2856,36 @@ def main() -> int:
         # host+CPU-jax work only — never claims a chip; the exit code is
         # the serve-smoke acceptance gate: continuous must beat batch-sync,
         # the prefix cache must cut mean TTFT at 90% shared-prefix traffic,
-        # and a live hot-swap must drop ZERO requests (ISSUE 11)
+        # a live hot-swap must drop ZERO requests (ISSUE 11), ragged
+        # attention must beat the dense gather at low pool occupancy and
+        # chunked prefill must cut the worst decode gap (ISSUE 12)
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         sv = serving_report()
         px = prefix_serving_report()
         hs = hotswap_live_report()
-        emit({"serving": sv, "serving_prefix": px, "serving_hotswap": hs})
+        rg = ragged_serving_report()
+        emit({"serving": sv, "serving_prefix": px, "serving_hotswap": hs,
+              "serving_ragged": rg})
         speedup = (sv or {}).get("speedup_at_max_concurrency")
         ttft_gain = (px or {}).get("ttft_speedup_at_max_shared")
         swap_ok = (hs is not None and hs["swaps_applied"] >= 1
                    and hs["dropped_during_swap"] == 0)
+        ragged_gain = (rg or {}).get("low_occupancy_speedup")
+        gap_ratio = ((rg or {}).get("chunked_tpot") or {}).get("gap_ratio")
         return 0 if (sv is not None and speedup and speedup > 1.0
-                     and ttft_gain and ttft_gain > 1.0 and swap_ok) else 1
+                     and ttft_gain and ttft_gain > 1.0 and swap_ok
+                     and ragged_gain and ragged_gain > 1.0
+                     and gap_ratio and gap_ratio > 1.0) else 1
+    if args.ragged:
+        # the ISSUE 12 gate alone (make bench-ragged): ragged beats the
+        # dense gather at low occupancy, chunked prefill protects TPOT
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        rg = ragged_serving_report()
+        emit({"serving_ragged": rg})
+        ragged_gain = (rg or {}).get("low_occupancy_speedup")
+        gap_ratio = ((rg or {}).get("chunked_tpot") or {}).get("gap_ratio")
+        return 0 if (ragged_gain and ragged_gain > 1.0
+                     and gap_ratio and gap_ratio > 1.0) else 1
     if args.collective:
         # CPU-jax only, fresh backend — the emulated client mesh must be
         # configured before jax initializes, which is why the in-run bench

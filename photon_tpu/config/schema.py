@@ -383,11 +383,26 @@ class ServeConfig:
     n_blocks: int = 0  # paged-pool size; 0 = auto (worst case, never blocks)
     max_queue: int = 64  # admission queue bound; overflow → HTTP 429
     max_new_tokens: int = 64  # per-request generation cap
-    # prefill/decode interleave: max prompt tokens prefilled per scheduler
-    # iteration before a decode step runs (a single over-budget prompt is
-    # still admitted — alone — so it can't deadlock; it just can't bring
-    # friends). Keeps one giant prompt from starving in-flight decodes.
+    # chunked prefill (ISSUE 12): max prompt tokens prefilled per MIXED
+    # step — a prompt larger than the budget is split across consecutive
+    # steps while decode rows ride along every step, so one giant prompt
+    # can delay a decode token by at most one budget-sized chunk (it used
+    # to stall every in-flight decode for its whole prefill).
     prefill_token_budget: int = 2048
+    # serving attention inner loop (ISSUE 12, ops/ragged_paged_attention):
+    #   "auto"   — the ragged live-block walk: the fused Pallas kernel
+    #              where Pallas runs (TPU), the bit-exact gather-reference
+    #              math over the live slice elsewhere;
+    #   "ragged" — the fused Pallas kernel, explicitly. Rejected at
+    #              validation on a non-Pallas backend unless
+    #              attention_interpret opts into the Pallas interpreter;
+    #   "gather" — the PR 5 full-width dense gather (the bit-exact
+    #              oracle; attention cost scales with POOL capacity —
+    #              keep it for parity debugging, not for serving).
+    attention_impl: str = "auto"
+    # run the ragged kernel through the Pallas interpreter (CPU-testable
+    # parity runs; far too slow for real serving — leave off otherwise)
+    attention_interpret: bool = False
     eos_id: int = -1  # default per-request EOS (-1 = none; requests may override)
     # graceful-drain bound (SIGTERM): /healthz flips to "draining", new
     # /generate gets 503 + Retry-After, and in-flight slots get up to this
@@ -713,6 +728,24 @@ class Config:
                 f"serve.prefill_token_budget must be >= 1, got "
                 f"{srv.prefill_token_budget}"
             )
+        if srv.attention_impl not in ("auto", "ragged", "gather"):
+            raise ValueError(
+                f"serve.attention_impl must be one of auto/ragged/gather, "
+                f"got {srv.attention_impl!r}"
+            )
+        if srv.attention_impl == "ragged" and not srv.attention_interpret:
+            # fail at VALIDATION, not at the first decode step: an
+            # explicitly-requested Pallas kernel needs a backend that can
+            # lower it (or the interpreter opt-in for CPU parity runs)
+            from photon_tpu.ops.flash_attention import pallas_supported
+
+            if not pallas_supported(None):
+                raise ValueError(
+                    "serve.attention_impl='ragged' needs a Pallas-capable "
+                    "backend (TPU); set serve.attention_interpret=true to "
+                    "run the kernel through the interpreter, or use 'auto' "
+                    "to fall back to the gather reference here"
+                )
         if srv.drain_timeout_s <= 0:
             raise ValueError(
                 f"serve.drain_timeout_s must be > 0, got {srv.drain_timeout_s}"

@@ -7,10 +7,12 @@ the live run round by round with zero dropped requests.
 Six layers, each testable alone:
 
 - :mod:`cache` — the paged KV pool: fixed block pool + per-slot block
-  tables + REFCOUNTED free-list recycling, a gather-based decode step
-  that is bit-exact with the contiguous ``models/decode.py`` greedy
-  path, and a suffix-only prefill for prefix-cache hits (same parity
-  bar);
+  tables + REFCOUNTED free-list recycling, and the unified MIXED
+  chunked-prefill step (ISSUE 12): decode rows and prompt chunks in one
+  program, attending through the tables at the live (ragged) width —
+  gather path bit-exact with the contiguous ``models/decode.py`` greedy
+  path, fused Pallas ragged-paged-attention path epsilon-pinned
+  (``ops/ragged_paged_attention.py``);
 - :mod:`prefix` — content-addressed prefix reuse: chain-hashed full
   prompt blocks shared copy-on-write across requests through an LRU of
   allocator-referenced blocks;

@@ -27,9 +27,16 @@ through the paged pool is bit-exact with the contiguous path
 
 TPU note: the pool's layer axis sits second (``[N, L, bs, H, D]`` — block
 major, so a block is one contiguous alloc unit); the step scans layers via
-a ``moveaxis`` view, which XLA folds into the gather. Kernel-level ragged
-paged attention (the Pallas route) would replace the gather+dense-attend
-here without touching the scheduler above it.
+a ``moveaxis`` view, which XLA folds into the gather.
+
+ISSUE 12 adds :func:`mixed_chunk_step` — ONE program that processes decode
+rows and prompt chunks together (chunked prefill), attends through the
+block tables at a static LIVE width ``n_ctx`` (the ragged walk: cost
+scales with live tokens, not pool capacity), and dispatches the per-layer
+attention between the bit-exact gather reference and the fused Pallas
+ragged-paged-attention kernel (``ops/ragged_paged_attention.py``,
+epsilon-tier). :func:`paged_decode_step` stays as the full-width oracle
+the parity harness compares against.
 """
 
 from __future__ import annotations
@@ -195,81 +202,107 @@ def write_prefill_blocks(state: PagedState, slot: int, block_ids: list[int],
     )
 
 
-def admit_write(state: PagedState, slot: jax.Array, row_ids: jax.Array,
-                cache_k: jax.Array, cache_v: jax.Array,
+def install_row(state: PagedState, slot: jax.Array, row: jax.Array,
                 length: jax.Array) -> PagedState:
-    """Jit-friendly admission writer (the engine compiles this once per
-    prompt-length bucket): scatter EVERY prefill block of ``cache_k/v``
-    (``[L, 1, S_pad, H_kv, Dh]``) through ``row_ids [max_blocks]`` and
-    install the row as ``slot``'s table.
-
-    Unlike :func:`write_prefill_blocks` (the op-by-op host reference, which
-    scatters exactly the blocks the prompt needs), every shape here is
-    static: padding blocks past the reservation simply route to the trash
-    block — ``row_ids``'s tail is the trash id — so the garbage rows the
-    bucketed prefill computed land where idle-slot writes already go."""
-    bs = state.block_size
-    L = cache_k.shape[0]
-    n_pad = cache_k.shape[2] // bs
-    kb = cache_k[:, 0, : n_pad * bs].reshape(L, n_pad, bs, *cache_k.shape[3:])
-    vb = cache_v[:, 0, : n_pad * bs].reshape(L, n_pad, bs, *cache_v.shape[3:])
-    targets = row_ids[:n_pad]
+    """Admission bookkeeping as one tiny program: point ``slot``'s table
+    at its reserved (possibly prefix-shared) physical blocks and park its
+    cursor at the cached-prefix depth. No KV moves — the chunk stream
+    (:func:`mixed_chunk_step`) writes the suffix KV as it prefills."""
     return PagedState(
-        cache_k=state.cache_k.at[targets].set(
-            kb.swapaxes(0, 1).astype(state.cache_k.dtype)),
-        cache_v=state.cache_v.at[targets].set(
-            vb.swapaxes(0, 1).astype(state.cache_v.dtype)),
-        block_tables=state.block_tables.at[slot].set(row_ids),
+        cache_k=state.cache_k,
+        cache_v=state.cache_v,
+        block_tables=state.block_tables.at[slot].set(row),
         lengths=state.lengths.at[slot].set(length),
     )
 
 
-def suffix_prefill_admit(params: dict, state: PagedState, slot: jax.Array,
-                         row_pad: jax.Array, tokens: jax.Array,
-                         start: jax.Array, length: jax.Array,
-                         cfg: ModelConfig) -> tuple[jax.Array, PagedState]:
-    """Prefill ONLY a prompt's uncached suffix through the paged pool
-    (ISSUE 11): positions ``[start, start + s_pad)`` attend through the
-    slot's block-table row — whose first ``start / block_size`` physical
-    blocks hold a cache-hit prefix's KV, computed by some earlier prefill —
-    while the suffix's own k/v scatter into the freshly-allocated suffix
-    blocks. Returns (next-token logits ``[1, V]`` at the prompt's cursor,
-    advanced state with ``slot``'s table row and length installed).
+def mixed_chunk_step(params: dict, state: PagedState, tokens: jax.Array,
+                     positions: jax.Array, q_valid: jax.Array,
+                     emit_off: jax.Array, lengths_after: jax.Array,
+                     chunk_slot: jax.Array, cfg: ModelConfig, *, n_ctx: int,
+                     has_chunk: bool = False, impl: str = "gather",
+                     interpret: bool = False) -> tuple[jax.Array, PagedState]:
+    """ONE serving program for a mixed chunked-prefill batch (ISSUE 12):
+    every slot contributes a row of ``tokens [n_slots, Tq]`` — a decode
+    row places its single last-emitted token in column 0 (rest padding),
+    the ``chunk_slot`` row (``has_chunk``) places its next prompt chunk,
+    idle slots are all padding — and attention runs through the block
+    tables at the static LIVE width ``n_ctx`` blocks (the ragged walk:
+    cost scales with the longest live slot, never with pool capacity).
+    Returns (logits ``[n_slots, V]`` at each slot's ``emit_off`` column,
+    advanced state with ``lengths_after`` installed).
 
-    Bit-parity argument (pinned by ``tests/test_serve_prefix.py``): the
-    cached prefix KV is bitwise what a cold full-prompt prefill computes
-    for those positions (causality: position ``p``'s k/v depend only on
-    tokens ``<= p``; masked pad contributions are exactly zero), and this
-    function mirrors the decode-step einsum formulation op for op, so its
-    logits AND the suffix KV it writes equal the cold path's bitwise.
+    This unifies the PR 5 prefill/decode program pair. Bit-exactness of
+    the gather path is BY GRAPH CONSTRUCTION, not by epsilon: the two
+    attention sub-graphs are op-for-op the two programs this step
+    replaces, so XLA lowers the same dots it lowered before —
 
-    Shape discipline: ``tokens`` is ``[1, s_pad]`` with ``s_pad`` bucketed
-    to a power-of-two block count (same buckets as cold prefill → at most
-    ``log2(max_blocks) + 1`` compiles); ``start``/``length``/``slot`` ride
-    as traced scalars so prefix depth never retraces. ``row_pad`` is the
-    table row EXTENDED by ``s_pad / block_size`` trash entries: the
-    suffix-block slice ``row_pad[start//bs : start//bs + s_pad//bs]`` can
-    then never clamp (a clamped dynamic slice would silently misalign the
-    scatter into live blocks), and pad blocks past the reservation write
-    into the trash block exactly like ``admit_write``'s tail.
+    - **decode columns** (column 0 of every slot) run exactly
+      :func:`paged_decode_step`'s grouped einsum
+      (``bkgd,bskd->bkgs``) over the table gather; masked tail
+      positions past ``n_ctx`` carry exactly-zero probability, so the
+      live-width cut is bitwise-invisible;
+    - **the chunk row** runs exactly :func:`suffix_prefill_admit`'s
+      per-slot einsum (``qkgd,skd->qkgs``) against its own gathered
+      view, and is spliced over the chunk slot's row with one dynamic
+      update (``chunk_slot`` rides traced — chunk depth, slot id and
+      prefix-hit depth never retrace). A prefix-cache hit just shortens
+      the chunk stream: the first chunk's positions start at the cached
+      depth (PR 10's suffix prefill is the single-chunk special case).
 
-    COW invariant: ``start`` is a whole-block boundary and every write here
-    targets ``row_pad`` entries at block index ``>= start // bs`` — a
-    shared (cached) prefix block is never written."""
+    Shared discipline (mirrors the programs it replaces): each layer
+    scatters every real token's k/v at ``(table[slot, pos//bs],
+    pos%bs)`` BEFORE any gather (chunk tokens attend to their own
+    chunk's earlier positions); padding rows write to the trash block
+    (``q_valid`` is the write mask) and read nothing (visibility is one
+    comparison, ``k_pos <= position`` — causality inside a chunk, the
+    live-length bound, and recycled bytes behind stale table entries
+    all at once). ``Tq`` and ``n_ctx`` are pow2-bucketed by the engine;
+    everything else is fixed-shape (the no-retrace discipline).
+
+    MoE caveat (``cfg.mlp == "moe"``): expert-capacity routing is
+    BATCH-GLOBAL (every row in the step competes for one capacity pool —
+    true of the PR 5 step too, where even idle slots' unmasked rows
+    claimed capacity), so neither the bit-parity-with-contiguous claim
+    nor batch-mate independence holds there; serving MoE is best-effort,
+    exactly as before. ``token_mask=q_valid`` at least keeps pad/idle
+    rows from claiming capacity — strictly less cross-row interference
+    than the PR 5 step, not more.
+
+    ``impl="ragged"`` swaps both attention sub-graphs for the fused
+    online-softmax Pallas kernel
+    (``ops/ragged_paged_attention.py``) — the EPSILON tier
+    (``interpret`` runs it through the Pallas interpreter off-TPU).
+    """
+    from photon_tpu.ops.ragged_paged_attention import ragged_paged_attention
+
     n_kv = cfg.n_kv_heads or cfg.n_heads
     group = cfg.n_heads // n_kv
     bs = state.block_size
-    m = state.block_tables.shape[1]
-    s_ctx = m * bs
-    _, s_pad = tokens.shape
-    n_suf = s_pad // bs
-    row = jax.lax.dynamic_slice(row_pad, (0,), (m,))
-    targets = jax.lax.dynamic_slice(row_pad, (start // bs,), (n_suf,))
-    pos = start + jnp.arange(s_pad)[None, :]  # [1, s_pad] absolute positions
-    x = _embed(params, tokens, pos, cfg)[0]  # [s_pad, D]
+    n_slots, tq = tokens.shape
+    s_ctx = n_ctx * bs
     scale = 1.0 / (cfg.d_head ** 0.5)
+    x = _embed(params, tokens, positions, cfg)  # [B, Tq, D]
+    # physical write target per token: pad rows → trash (idle slots and
+    # slot padding never touch live blocks; eviction stays pure host
+    # bookkeeping exactly as in paged_decode_step)
+    blk = jnp.minimum(positions // bs, state.block_tables.shape[1] - 1)
+    phys = jnp.take_along_axis(state.block_tables, blk, axis=1)  # [B, Tq]
+    phys = jnp.where(q_valid, phys, state.trash_block)
+    off = positions % bs
+    rows = jax.lax.slice_in_dim(state.block_tables, 0, n_ctx, axis=1)
     k_pos = jnp.arange(s_ctx)
-    valid = (k_pos[None, :] <= pos[0][:, None])  # [s_pad, s_ctx] causal+garbage
+    pos0 = positions[:, 0]  # decode-column positions
+    valid0 = k_pos[None, :] <= pos0[:, None]  # [B, s_ctx]
+    if has_chunk:
+        pos_c = jax.lax.dynamic_index_in_dim(
+            positions, chunk_slot, axis=0, keepdims=False
+        )  # [Tq]
+        row_c = jax.lax.dynamic_index_in_dim(
+            rows, chunk_slot, axis=0, keepdims=False
+        )  # [n_ctx]
+        valid_c = k_pos[None, :] <= pos_c[:, None]  # [Tq, s_ctx]
+    valid_f = q_valid.astype(jnp.float32)
 
     ck_l = jnp.moveaxis(state.cache_k, 1, 0)  # [L, NB, bs, H, D] view
     cv_l = jnp.moveaxis(state.cache_v, 1, 0)
@@ -278,42 +311,79 @@ def suffix_prefill_admit(params: dict, state: PagedState, slot: jax.Array,
         lp, ck, cv = xs  # ck/cv: [NB, bs, H_kv, Dh] — this layer's pool
         h = _norm(x, lp["ln_1"]["scale"], lp["ln_1"].get("bias"),
                   cfg.norm, cfg.norm_eps)
-        q, k_new, v_new = _qkv(lp, h, cfg)  # q [s_pad,H,Dh], k/v [s_pad,Hkv,Dh]
+        q, k_new, v_new = _qkv(lp, h, cfg)  # q [B,Tq,H,Dh], k/v [B,Tq,Hkv,Dh]
         if cfg.rope:
-            q = _rope_at(q[None], pos, cfg.rope_theta)[0]
-            k_new = _rope_at(k_new[None], pos, cfg.rope_theta)[0]
-        # scatter the suffix k/v into its physical blocks FIRST (write →
-        # gather, the paged_decode_step discipline), pad blocks → trash
-        kb = k_new.reshape(n_suf, bs, n_kv, cfg.d_head)
-        vb = v_new.reshape(n_suf, bs, n_kv, cfg.d_head)
-        ck = ck.at[targets].set(kb.astype(ck.dtype))
-        cv = cv.at[targets].set(vb.astype(cv.dtype))
-        # block-table gather → the slot's logical [s_ctx, H, D] view
-        gk = ck[row].reshape(s_ctx, n_kv, cfg.d_head)
-        gv = cv[row].reshape(s_ctx, n_kv, cfg.d_head)
-        qg = q.reshape(s_pad, n_kv, group, cfg.d_head)
-        scores = jnp.einsum("qkgd,skd->qkgs", qg, gk,
-                            preferred_element_type=jnp.float32) * scale
-        if cfg.alibi:
-            dist = (pos[0][:, None] - k_pos[None, :]).astype(jnp.float32)
-            slopes = alibi_slopes(cfg.n_heads).reshape(n_kv, group)
-            scores = scores - slopes[None, :, :, None] * dist[:, None, None, :]
-        scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("qkgs,skd->qkgd", probs.astype(gv.dtype), gv)
-        x = x + _dense(lp, "out_proj", out.reshape(s_pad, cfg.d_model))
-        return _mlp(lp, x, cfg), (ck, cv)
+            q = _rope_at(q, positions, cfg.rope_theta)
+            k_new = _rope_at(k_new, positions, cfg.rope_theta)
+        # scatter first (write → gather): every real token's k/v lands at
+        # its (physical block, offset) before any row reads it
+        ck = ck.at[phys, off].set(k_new.astype(ck.dtype))
+        cv = cv.at[phys, off].set(v_new.astype(cv.dtype))
+        if impl == "ragged":
+            out0 = ragged_paged_attention(
+                q[:, :1], ck, cv, rows, pos0[:, None], scale=scale,
+                slopes=alibi_slopes(cfg.n_heads) if cfg.alibi else None,
+                interpret=interpret,
+            )[:, 0]  # [B, H, Dh]
+        else:
+            # decode columns: op-for-op paged_decode_step
+            gk = ck[rows].reshape(n_slots, s_ctx, n_kv, cfg.d_head)
+            gv = cv[rows].reshape(n_slots, s_ctx, n_kv, cfg.d_head)
+            qg = q[:, 0].reshape(n_slots, n_kv, group, cfg.d_head)
+            scores = jnp.einsum("bkgd,bskd->bkgs", qg, gk,
+                                preferred_element_type=jnp.float32) * scale
+            if cfg.alibi:
+                dist = (pos0[:, None] - k_pos[None, :]).astype(jnp.float32)
+                slopes = alibi_slopes(cfg.n_heads).reshape(n_kv, group)
+                scores = scores - slopes[None, :, :, None] * dist[:, None, None, :]
+            scores = jnp.where(valid0[:, None, None, :], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out0 = jnp.einsum("bkgs,bskd->bkgd", probs.astype(gv.dtype), gv)
+            out0 = out0.reshape(n_slots, cfg.n_heads, cfg.d_head)
+        attn = jnp.broadcast_to(
+            out0[:, None], (n_slots, tq, cfg.n_heads, cfg.d_head)
+        )
+        if has_chunk:
+            qc = jax.lax.dynamic_index_in_dim(
+                q, chunk_slot, axis=0, keepdims=False
+            )  # [Tq, H, Dh]
+            if impl == "ragged":
+                out_c = ragged_paged_attention(
+                    qc[None], ck, cv, row_c[None], pos_c[None], scale=scale,
+                    slopes=alibi_slopes(cfg.n_heads) if cfg.alibi else None,
+                    interpret=interpret,
+                )[0]  # [Tq, H, Dh]
+            else:
+                # the chunk row: op-for-op suffix_prefill_admit
+                gkc = ck[row_c].reshape(s_ctx, n_kv, cfg.d_head)
+                gvc = cv[row_c].reshape(s_ctx, n_kv, cfg.d_head)
+                qcg = qc.reshape(tq, n_kv, group, cfg.d_head)
+                sc = jnp.einsum("qkgd,skd->qkgs", qcg, gkc,
+                                preferred_element_type=jnp.float32) * scale
+                if cfg.alibi:
+                    dist = (pos_c[:, None] - k_pos[None, :]).astype(jnp.float32)
+                    slopes = alibi_slopes(cfg.n_heads).reshape(n_kv, group)
+                    sc = sc - slopes[None, :, :, None] * dist[:, None, None, :]
+                sc = jnp.where(valid_c[:, None, None, :], sc, -jnp.inf)
+                pc = jax.nn.softmax(sc, axis=-1)
+                out_c = jnp.einsum("qkgs,skd->qkgd", pc.astype(gvc.dtype), gvc)
+                out_c = out_c.reshape(tq, cfg.n_heads, cfg.d_head)
+            attn = jax.lax.dynamic_update_index_in_dim(
+                attn, out_c.astype(attn.dtype), chunk_slot, axis=0
+            )
+        x = x + _dense(lp, "out_proj",
+                       attn.reshape(n_slots, tq, cfg.d_model))
+        return _mlp(lp, x, cfg, token_mask=valid_f), (ck, cv)
 
     x, (ck_l, cv_l) = jax.lax.scan(
         layer, x, (params["blocks"]["block"], ck_l, cv_l)
     )
-    last = x[length - start - 1]  # the prompt's final (real) suffix token
-    logits = _logits(params, last[None], cfg)
-    return logits, PagedState(
+    last = jnp.take_along_axis(x, emit_off[:, None, None], axis=1)[:, 0]
+    return _logits(params, last, cfg), PagedState(
         cache_k=jnp.moveaxis(ck_l, 0, 1),
         cache_v=jnp.moveaxis(cv_l, 0, 1),
-        block_tables=state.block_tables.at[slot].set(row),
-        lengths=state.lengths.at[slot].set(length),
+        block_tables=state.block_tables,
+        lengths=lengths_after,
     )
 
 

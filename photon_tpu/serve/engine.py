@@ -1,23 +1,40 @@
 """The jit'd serving engine: fixed-shape slot arrays over the paged pool.
 
 One :class:`PagedEngine` owns the device state (paged KV pool, block
-tables, per-slot cursors/temperatures/PRNG keys) and three compiled
-programs:
+tables, per-slot cursors/temperatures/PRNG keys) and — since ISSUE 12 —
+TWO compiled programs instead of the PR 5 prefill/decode pair:
 
-- the shared prefill from :func:`models.decode.decode_jit_pair` (one trace
-  per prompt-length bucket — prompts pad to a power-of-two block count, so
-  at most ``log2(max_blocks)+1`` compiles ever happen);
-- ``_step``: one :func:`~photon_tpu.serve.cache.paged_decode_step` +
-  per-slot sampling over ALL ``n_slots`` slots, fixed shapes throughout —
-  admission and eviction never retrace (eviction is pure host bookkeeping:
-  the step trash-routes idle slots' writes, so stale tables are inert);
-- ``_admit_write``: the one-call admission scatter
-  (:func:`~photon_tpu.serve.cache.admit_write`, per prompt bucket) —
-  op-by-op host writes cost ~10 dispatches per admission on a 1-core host.
+- ``_install_jit``: admission bookkeeping (:func:`serve.cache.install_row`
+  — point the slot's table at its reserved blocks, park the cursor at the
+  prefix-hit depth). One compile, no KV movement.
+- ``_mixed_jit``: the unified mixed chunked-prefill step
+  (:func:`serve.cache.mixed_chunk_step` + per-slot sampling). Decode rows
+  and ONE prompt chunk run in the same program; prompts prefill as a
+  stream of chunks instead of one monolithic prefill, so a giant prompt
+  can't monopolize a step. Attention walks the block tables at the LIVE
+  width (``n_ctx`` blocks) — the ragged-paged-attention shape — so
+  attention cost scales with live tokens, not pool capacity.
+
+Shape discipline (the no-retrace contract, machine-checked by the
+photon-lint sentinel tests): chunk width ``Tq`` buckets to a power-of-two
+BLOCK count exactly like the old prefill (<= ``log2(max_blocks)+1``
+shapes, and a chunk's width depends only on its own request + the chunk
+budget — never on batch-mates); decode-only steps are ``Tq == 1``; the
+live width ``n_ctx`` is a pow2 bucket of the longest ACTIVE reservation
+and rises MONOTONICALLY (high-water) — it never shrinks, so a warm
+engine's bucket set is a deterministic function of the traffic profile,
+not of admission timing. ``serve.attention_impl`` picks the attention
+inner graph: the bit-exact gather reference or the fused Pallas ragged
+kernel (``ops/ragged_paged_attention.py``).
 
 Sampling is per request: ``temperature == 0`` rows take argmax (bit-exact
 with the offline greedy path), others sample from seeded per-slot PRNG
-streams (same seed → same completion, independent of batch-mates).
+streams (same seed → same completion, independent of batch-mates — a
+slot's key advances only on steps where that slot emits, so the chunk
+schedule can't perturb the stream). MoE models are the one exception to
+every batch-mate-independence and parity claim here: expert-capacity
+routing is batch-global (as it was in the PR 5 step), so MoE serving
+stays best-effort — see the ``mixed_chunk_step`` docstring.
 
 Params come either straight from a pytree or — the train→serve loop — via
 :meth:`from_checkpoint`: ``ServerCheckpointManager.load_round_params`` (the
@@ -25,12 +42,13 @@ params-only path: no dead Adam moments), momenta split off for
 momenta-aggregating runs, leaves restored onto the model template.
 
 Thread-discipline: ONE driver thread (the scheduler loop) calls
-admit/step/evict; HTTP handler threads only read the scalar stats. The
-step donates the previous state, so the pool is updated in place.
+begin/mixed_step/evict; HTTP handler threads only read the scalar stats.
+The step donates the previous state, so the pool is updated in place.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
 from typing import Any
 
 import jax
@@ -38,14 +56,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu.config.schema import Config, ModelConfig
-from photon_tpu.models.decode import decode_jit_pair
 from photon_tpu.serve.cache import (
     BlockAllocator,
     PagedState,
-    admit_write,
     init_paged_state,
-    paged_decode_step,
-    suffix_prefill_admit,
+    install_row,
+    mixed_chunk_step,
 )
 from photon_tpu.serve.prefix import PrefixCache, prefix_hashes
 
@@ -57,9 +73,6 @@ def _sample_rows(logits: jax.Array, temps: jax.Array,
     scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
     sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
-
-
-_sample_jit = jax.jit(_sample_rows)
 
 
 def load_serving_params(cfg: Config, mgr: Any, server_round: int) -> Any:
@@ -77,6 +90,18 @@ def load_serving_params(cfg: Config, mgr: Any, server_round: int) -> Any:
     return params_from_ndarrays(init_params(cfg.model, seed=0), meta, arrays)
 
 
+@dataclass
+class _Prefill:
+    """Host-side chunk cursor for a prompt mid-prefill: positions
+    ``[pos, n)`` still need to run through the chunk stream."""
+
+    prompt: list[int] = field(default_factory=list)
+    pos: int = 0  # next position to prefill (starts at the prefix-hit depth)
+    n: int = 0  # full prompt length
+    hashes: list[bytes] = field(default_factory=list)
+    row_blocks: list[int] = field(default_factory=list)
+
+
 class PagedEngine:
     def __init__(self, cfg: Config, params: Any, *,
                  loaded_round: int | None = None) -> None:
@@ -91,6 +116,35 @@ class PagedEngine:
         self.loaded_round = loaded_round
         self.params = jax.tree.map(jnp.asarray, params)
         self.allocator = BlockAllocator(self.n_blocks)
+        # -- attention impl resolution (ISSUE 12; validated in schema.py) --
+        # "gather": the PR 5 full-width dense gather — the bit-exact
+        #   oracle whose cost scales with POOL capacity;
+        # "auto": the ragged live-block walk — fused Pallas kernel where
+        #   Pallas runs (TPU), the bit-exact gather REFERENCE math over
+        #   the live slice elsewhere;
+        # "ragged": the fused kernel, explicitly — schema validation
+        #   already rejected it on a non-Pallas backend unless
+        #   attention_interpret opted into the Pallas interpreter.
+        impl = getattr(sc, "attention_impl", "auto")
+        interpret = bool(getattr(sc, "attention_interpret", False))
+        if impl == "gather":
+            self._ctx_full, self._use_kernel = True, False
+        elif impl == "ragged":
+            self._ctx_full, self._use_kernel = False, True
+        else:  # auto
+            from photon_tpu.ops.flash_attention import pallas_supported
+
+            self._ctx_full = False
+            self._use_kernel = pallas_supported(None) or interpret
+        self._interpret = interpret
+        self.attn_impl = "gather" if self._ctx_full else (
+            "ragged" if self._use_kernel else "ragged-ref"
+        )
+        # live-width high-water mark (blocks): monotone so a warm
+        # engine's (Tq, n_ctx) bucket set depends only on the traffic
+        # profile — never on admission timing (the retrace sentinel
+        # tests lean on this determinism)
+        self._ctx_hw = 1
         # content-addressed prefix reuse (ISSUE 11, serve/prefix.py): OFF
         # unless opted in, and never for MoE — expert-capacity routing is
         # batch-global, so a prefix block's KV is not a pure function of
@@ -109,30 +163,37 @@ class PagedEngine:
         self._keys = jnp.zeros((self.n_slots, 2), jnp.uint32)
         self._temps = jnp.zeros((self.n_slots,), jnp.float32)
         self._last = np.zeros(self.n_slots, np.int32)  # last emitted token
+        self._lengths = np.zeros(self.n_slots, np.int32)  # host cursor mirror
         self._active = np.zeros(self.n_slots, bool)
         self._slot_blocks: list[list[int]] = [[] for _ in range(self.n_slots)]
-        self._prefill_jit, _ = decode_jit_pair(self.mc)
+        self._pending: dict[int, _Prefill] = {}  # slot -> chunk cursor
         mc = self.mc
+        use_kernel, interp = self._use_kernel, self._interpret
 
-        def step_fn(params, state, tokens, active, temps, keys):
-            logits, state = paged_decode_step(params, state, tokens, mc, active)
+        def step_fn(params, state, tokens, positions, q_valid, emit_off,
+                    emit_mask, lengths_after, chunk_slot, temps, keys,
+                    *, n_ctx, has_chunk):
+            logits, state = mixed_chunk_step(
+                params, state, tokens, positions, q_valid, emit_off,
+                lengths_after, chunk_slot, mc, n_ctx=n_ctx,
+                has_chunk=has_chunk,
+                impl="ragged" if use_kernel else "gather",
+                interpret=interp,
+            )
             sub = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
             nxt = _sample_rows(logits, temps, sub[:, 0])
-            nxt = jnp.where(active, nxt, 0)
-            return state, nxt, sub[:, 1]
+            nxt = jnp.where(emit_mask, nxt, 0)
+            # a slot's PRNG stream advances only when it emits: the chunk
+            # schedule (how many steps a batch-mate's prefill took) can
+            # never perturb another request's sampled completion
+            keys = jnp.where(emit_mask[:, None], sub[:, 1], keys)
+            return state, nxt, keys
 
-        self._step = jax.jit(step_fn, donate_argnums=(1, 5))
-        # admission as ONE compiled program (donating the state): the
-        # op-by-op host scatter costs ~10 dispatches per admission on a
-        # 1-core host, which would tax BOTH sides of the serving bench
-        self._admit_write = jax.jit(admit_write, donate_argnums=0)
-        # suffix-only admission for prefix-cache hits: one compile per
-        # suffix bucket (the same pow2 block-count buckets as cold prefill)
-        self._suffix_admit = jax.jit(
-            lambda p, st, slot, row, tok, start, length:
-            suffix_prefill_admit(p, st, slot, row, tok, start, length, mc),
-            donate_argnums=1,
+        self._mixed_jit = jax.jit(
+            step_fn, static_argnames=("n_ctx", "has_chunk"),
+            donate_argnums=(1, 10),
         )
+        self._install_jit = jax.jit(install_row, donate_argnums=0)
 
     # -- checkpoint loading ----------------------------------------------
     @classmethod
@@ -187,7 +248,7 @@ class PagedEngine:
         """With ``prompt`` given and the prefix cache on, admissibility
         accounts for cache hits (fewer fresh blocks needed) AND for
         reclaimable cache-held blocks (entries no live slot shares —
-        evictable under pressure by :meth:`admit`'s ``ensure_free``)."""
+        evictable under pressure by :meth:`begin`'s ``ensure_free``)."""
         if self.free_slot() is None:
             return False
         hit, fresh_needed, _ = self._prefix_plan(
@@ -205,10 +266,10 @@ class PagedEngine:
         prompt's full-block chain hashes — ALL of them, up to
         ``prompt_len // block_size``, so admission can reuse this one
         sweep for both lookup and insert). Lookups are capped one block
-        short of the prompt's end so the suffix always keeps at least the
-        final prompt token — its forward pass produces the first sampled
-        token's logits. ``touch=False`` = read-only peek (can_admit's
-        per-tick retries must not reshuffle LRU order)."""
+        short of the prompt's end so the chunk stream always keeps at
+        least the final prompt token — its forward pass produces the
+        first sampled token's logits. ``touch=False`` = read-only peek
+        (can_admit's per-tick retries must not reshuffle LRU order)."""
         need = self.blocks_needed(prompt_len, max_new)
         if self.prefix_cache is None or not prompt:
             return [], need, []
@@ -223,7 +284,7 @@ class PagedEngine:
     def _chain_hashes(self, prompt: list[int], prompt_len: int) -> list[bytes]:
         """One chain-hash sweep per prompt LIST OBJECT: a single-slot memo
         keyed by identity (the memo holds the list alive, so the ``is``
-        check can never alias a recycled id). Covers the can_admit→admit
+        check can never alias a recycled id). Covers the can_admit→begin
         pair and a capacity-blocked queue head's per-tick retries —
         hashing is content-pure, so a stale entry is impossible."""
         memo = self._hash_memo
@@ -246,6 +307,11 @@ class PagedEngine:
     def free_blocks(self) -> int:
         return self.allocator.free_blocks
 
+    def pending_tokens(self, slot: int) -> int:
+        """Prompt tokens still to prefill for ``slot`` (0 = decoding)."""
+        p = self._pending.get(slot)
+        return 0 if p is None else p.n - p.pos
+
     def prefix_stats(self) -> dict | None:
         """Prefix-cache counters for /healthz and the KPI tick (None when
         the cache is off)."""
@@ -259,26 +325,61 @@ class PagedEngine:
             "tokens_cached": pc.tokens_cached,
         }
 
+    def attn_stats(self) -> dict[str, float]:
+        """Attention-plane gauges for the scheduler's KPI tick: the live
+        walk width, the pool's live fraction, and whether the ragged walk
+        (vs the full-width gather) is active."""
+        return {
+            "ctx_blocks": float(self.max_blocks if self._ctx_full
+                                else self._ctx_hw),
+            "live_frac": (self.n_blocks - self.allocator.free_blocks)
+            / self.n_blocks,
+            "ragged": 0.0 if self._ctx_full else 1.0,
+        }
+
     # -- admission / step / eviction --------------------------------------
-    def _bucket(self, prompt_len: int) -> int:
-        """Prompt pad width: power-of-two BLOCK count (so the shared prefill
-        compiles at most log2(max_blocks)+1 distinct shapes), capped at the
-        slot capacity."""
-        need = max(1, -(-prompt_len // self.block_size))
+    def _bucket(self, n_tokens: int) -> int:
+        """Chunk pad width: power-of-two BLOCK count (so the mixed step
+        compiles at most log2(max_blocks)+1 distinct chunk widths), capped
+        at the slot capacity. Also the pad rule that keeps the gather
+        path BITWISE stable: XLA's row lowering is block-count invariant
+        on the pinned shapes, single-row einsums are not."""
+        need = max(1, -(-n_tokens // self.block_size))
         return min(1 << (need - 1).bit_length(), self.max_blocks) * self.block_size
 
-    def admit(self, slot: int, prompt: list[int], max_new: int,
-              temperature: float = 0.0, seed: int = 0) -> int:
-        """Prefill ``prompt`` into ``slot``'s reserved blocks and return the
-        request's FIRST generated token. Reserves the worst case
-        ``blocks_needed(len, max_new)`` up front — an admitted request can
-        never die of pool exhaustion mid-flight (the no-preemption design;
-        docs/serving.md).
+    def _ctx_width(self) -> int:
+        """The step's live attention width in blocks: pow2 bucket of the
+        longest ACTIVE reservation, monotone high-water (never shrinks) —
+        a warm engine's compiled widths are a function of the traffic
+        profile, not of which requests happened to overlap. The 'gather'
+        impl pins it at full table width (the PR 5 cost model)."""
+        if self._ctx_full:
+            return self.max_blocks
+        need = max(
+            (len(self._slot_blocks[s]) for s in range(self.n_slots)
+             if self._active[s]),
+            default=1,
+        )
+        w = min(1 << (max(1, need) - 1).bit_length(), self.max_blocks)
+        self._ctx_hw = max(self._ctx_hw, w)
+        return self._ctx_hw
+
+    def begin(self, slot: int, prompt: list[int], max_new: int,
+              temperature: float = 0.0, seed: int = 0) -> None:
+        """Reserve ``slot`` for a request and stage its chunk stream —
+        the cheap half of admission (no model compute): reserve the worst
+        case ``blocks_needed(len, max_new)`` blocks up front (an admitted
+        request can never die of pool exhaustion mid-flight — the
+        no-preemption design, docs/serving.md), install the block-table
+        row, park the cursor at the prefix-hit depth. The prompt's
+        (suffix) tokens then prefill through :meth:`mixed_step` chunks;
+        the step whose chunk covers the final prompt token emits the
+        request's first sampled token.
 
         With the prefix cache on, the longest cached full-block prefix is
         mapped copy-on-write into the slot's table (one retain per shared
-        block — never written: decode's first write lands strictly past
-        it) and prefill runs only on the uncached suffix."""
+        block — never written: every chunk/decode write lands strictly
+        past it) and the chunk stream starts at the cached depth."""
         if self._active[slot]:
             raise RuntimeError(f"slot {slot} is occupied")
         n = len(prompt)
@@ -306,98 +407,166 @@ class PagedEngine:
                     "paged pool exhausted (caller must can_admit first)"
                 )
             row_blocks = hit + ids
-            if k == 0:
-                # cold path: full-prompt prefill (unchanged — the original
-                # bit-parity path, also what every cache MISS takes)
-                s_pad = max(self._bucket(n), n)
-                tokens = np.zeros((1, s_pad), np.int32)
-                tokens[0, :n] = prompt
-                lengths = jnp.asarray([n], jnp.int32)
-                logits, cst = self._prefill_jit(
-                    self.params, jnp.asarray(tokens), lengths
-                )
-                row_ids = np.full(self.max_blocks, self.n_blocks, np.int32)
-                row_ids[: len(ids)] = ids
-                self.state = self._admit_write(
-                    self.state, jnp.int32(slot), jnp.asarray(row_ids),
-                    cst.cache_k, cst.cache_v, jnp.int32(n),
-                )
-            else:
-                # warm path: prefill ONLY the uncached suffix, attending
-                # through the shared prefix blocks via the table row
-                start = k * self.block_size
-                suffix = prompt[start:]
-                s_pad = max(self._bucket(len(suffix)), len(suffix))
-                n_suf = s_pad // self.block_size
-                tokens = np.zeros((1, s_pad), np.int32)
-                tokens[0, : len(suffix)] = suffix
-                # row + n_suf trash entries: the in-program suffix-block
-                # slice can never clamp, pad blocks land in the trash
-                row_pad = np.full(self.max_blocks + n_suf, self.n_blocks,
-                                  np.int32)
-                row_pad[: len(row_blocks)] = row_blocks
-                logits, self.state = self._suffix_admit(
-                    self.params, self.state, jnp.int32(slot),
-                    jnp.asarray(row_pad), jnp.asarray(tokens),
-                    jnp.int32(start), jnp.int32(n),
-                )
-            sub, carry = jax.random.split(jax.random.PRNGKey(seed))
-            first = int(_sample_jit(
-                logits, jnp.asarray([temperature], jnp.float32), sub[None]
-            )[0])
+            row = np.full(self.max_blocks, self.n_blocks, np.int32)
+            row[: len(row_blocks)] = row_blocks
+            start = k * self.block_size
+            self.state = self._install_jit(
+                self.state, jnp.int32(slot), jnp.asarray(row), jnp.int32(start)
+            )
         except BaseException:
             # transactional: a failed admission must not leak its blocks
             # (fresh allocations AND the references it took on shared
             # ones). A partially-written table row is harmless — the
-            # decode step trash-routes every INACTIVE slot's writes, and
+            # mixed step trash-routes every pad/idle row's writes, and
             # re-admission overwrites the row
             if ids is not None:
                 self.allocator.free(ids)
             if retained:
                 self.allocator.free(hit)
             raise
-        self._keys = self._keys.at[slot].set(carry)
+        self._keys = self._keys.at[slot].set(jax.random.PRNGKey(seed))
         self._temps = self._temps.at[slot].set(float(temperature))
         self._slot_blocks[slot] = row_blocks
         self._active[slot] = True
-        self._last[slot] = first
+        self._lengths[slot] = start
+        self._last[slot] = 0
+        self._pending[slot] = _Prefill(
+            prompt=list(prompt), pos=start, n=n, hashes=hashes,
+            row_blocks=row_blocks,
+        )
         if self.prefix_cache is not None:
-            # index this prompt's full blocks for the next request (insert
-            # skips hashes already present; each new entry takes one
-            # allocator reference so it survives this request's eviction).
-            # `hashes` already covers all n // block_size full blocks —
-            # one chain-hash sweep per admission, reused here
-            full = n // self.block_size
-            self.prefix_cache.insert(hashes, row_blocks[:full])
             self.prefix_cache.tokens_seen += n
             self.prefix_cache.tokens_cached += k * self.block_size
+
+    def mixed_step(self, chunk: tuple[int, int] | None = None, *,
+                   include_decode: bool = True
+                   ) -> tuple[np.ndarray, np.ndarray]:
+        """ONE unified serving step: every active non-prefilling slot
+        decodes its last token; ``chunk = (slot, n_tokens)`` additionally
+        advances that slot's prompt by up to ``n_tokens`` positions.
+        Returns ``(next_token [n_slots], emitted [n_slots])`` — a decode
+        row emits every step, a prefilling slot emits exactly once, on
+        the step whose chunk covers its final prompt token (the request's
+        FIRST sampled token). ``include_decode=False`` runs the chunk
+        alone (the synchronous :meth:`admit` path — batch-mates' streams
+        must not advance)."""
+        B = self.n_slots
+        decode_slots = [
+            s for s in range(B)
+            if include_decode and self._active[s] and s not in self._pending
+        ]
+        seg: list[int] = []
+        cs = 0
+        final = False
+        if chunk is not None:
+            cs, want = chunk
+            p = self._pending[cs]
+            cn = min(want, p.n - p.pos)
+            if cn < 1:
+                raise RuntimeError(f"slot {cs} has no pending prompt tokens")
+            seg = p.prompt[p.pos: p.pos + cn]
+            final = p.pos + cn == p.n
+        if not seg and not decode_slots:
+            raise RuntimeError("mixed_step with no work")
+        tq = self._bucket(len(seg)) if seg else 1
+        tokens = np.zeros((B, tq), np.int32)
+        positions = np.zeros((B, tq), np.int32)
+        q_valid = np.zeros((B, tq), bool)
+        emit_off = np.zeros(B, np.int32)
+        emit_mask = np.zeros(B, bool)
+        lengths_after = self._lengths.copy()
+        for s in decode_slots:
+            tokens[s, 0] = self._last[s]
+            positions[s, 0] = self._lengths[s]
+            q_valid[s, 0] = True
+            emit_mask[s] = True
+            lengths_after[s] += 1
+        if seg:
+            p = self._pending[cs]
+            cn = len(seg)
+            tokens[cs, :cn] = seg
+            positions[cs, :cn] = np.arange(p.pos, p.pos + cn)
+            q_valid[cs, :cn] = True
+            lengths_after[cs] = p.pos + cn
+            if final:
+                emit_off[cs] = cn - 1
+                emit_mask[cs] = True
+        self.state, nxt, self._keys = self._mixed_call(
+            self._ctx_width(), bool(seg), self.params, self.state,
+            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(q_valid),
+            jnp.asarray(emit_off), jnp.asarray(emit_mask),
+            jnp.asarray(lengths_after), jnp.int32(cs), self._temps, self._keys,
+        )
+        out = np.asarray(nxt)
+        self._lengths = lengths_after
+        for s in decode_slots:
+            self._last[s] = out[s]
+        if seg:
+            p = self._pending[cs]
+            p.pos += len(seg)
+            if final:
+                self._last[cs] = out[cs]
+                self._finish_prefill(cs, p)
+        return out, emit_mask
+
+    def _mixed_call(self, n_ctx: int, has_chunk: bool, *args):
+        """The one seam between host bookkeeping and the donated device
+        call (tests inject failures here: raising BEFORE the jitted call
+        leaves the donated state untouched, so a failed step is
+        recoverable at the scheduler layer)."""
+        return self._mixed_jit(*args, n_ctx=n_ctx, has_chunk=has_chunk)
+
+    def _finish_prefill(self, slot: int, p: _Prefill) -> None:
+        """Prompt fully prefilled: index its full blocks for the next
+        request. Insertion waits until HERE — the blocks' KV exists only
+        once every chunk has run, and indexing earlier could hand another
+        admission unwritten bytes."""
+        del self._pending[slot]
+        if self.prefix_cache is not None:
+            full = p.n // self.block_size
+            self.prefix_cache.insert(p.hashes, p.row_blocks[:full])
+
+    def admit(self, slot: int, prompt: list[int], max_new: int,
+              temperature: float = 0.0, seed: int = 0) -> int:
+        """Synchronous admission (compat shim over the chunked flow, used
+        by tests and offline callers): stage the request and run its whole
+        suffix as ONE chunk — no decode ride-alongs, so batch-mates'
+        streams don't advance — returning the first sampled token. The
+        scheduler's chunked path (:meth:`begin` + budgeted
+        :meth:`mixed_step`) is the serving-loop route."""
+        self.begin(slot, prompt, max_new, temperature=temperature, seed=seed)
+        first: int | None = None
+        while self.pending_tokens(slot) > 0:
+            nxt, emitted = self.mixed_step(
+                (slot, self.pending_tokens(slot)), include_decode=False
+            )
+            if emitted[slot]:
+                first = int(nxt[slot])
+        assert first is not None  # the final chunk always emits
         return first
 
     def step(self) -> np.ndarray:
-        """One decode step for every active slot; returns next token ids
-        ``[n_slots]`` (zeros at inactive slots — callers mask by activity).
-        Each active slot's previously-emitted token is placed at its cursor,
-        so the returned ids are each sequence's NEXT token."""
+        """One decode step for every active non-prefilling slot; returns
+        next token ids ``[n_slots]`` (zeros at inactive slots — callers
+        mask by activity). Each active slot's previously-emitted token is
+        placed at its cursor, so the returned ids are each sequence's
+        NEXT token."""
         if not self._active.any():
             raise RuntimeError("no active slots")
-        active = jnp.asarray(self._active)
-        self.state, nxt, self._keys = self._step(
-            self.params, self.state, jnp.asarray(self._last),
-            active, self._temps, self._keys,
-        )
-        out = np.asarray(nxt)
-        self._last = np.where(self._active, out, self._last).astype(np.int32)
+        out, _ = self.mixed_step(None)
         return out
 
     def evict(self, slot: int) -> None:
         """Return ``slot``'s blocks to the free list — pure host
-        bookkeeping: the decode step trash-routes inactive slots' writes,
+        bookkeeping: the mixed step trash-routes inactive slots' writes,
         so the stale table row needs no device-side reset, and recycled
-        pool bytes are NOT cleared (the valid-mask makes stale rows
+        pool bytes are NOT cleared (the position mask makes stale rows
         unreadable)."""
         if not self._active[slot]:
             raise RuntimeError(f"slot {slot} is not active")
         self.allocator.free(self._slot_blocks[slot])
         self._slot_blocks[slot] = []
+        self._pending.pop(slot, None)
         self._active[slot] = False
         self._last[slot] = 0
+        self._lengths[slot] = 0

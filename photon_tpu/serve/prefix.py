@@ -27,13 +27,19 @@ Three pieces:
   when that request evicts.
 
 Copy-on-write invariant: a cached block is full (entirely covered by
-prompt tokens) and the decode cursor of every request mapping it starts
-strictly past it, so shared blocks are **never written** — the first
-block a request may write (its partial tail, or the block its first
-generated token lands in) is always freshly allocated. The engine caps
-lookups at ``(len(prompt) - 1) // block_size`` blocks so the suffix
-always retains at least the final prompt token: its forward pass is what
-produces the first sampled token's logits.
+prompt tokens) and every write of every request mapping it lands
+strictly past it — since ISSUE 12 a hit just SHORTENS the chunk stream
+(the mixed step's chunk positions start at the cached depth, so chunk
+and decode scatters both target block indices past the shared prefix) —
+meaning shared blocks are **never written**: the first block a request
+may write (its partial tail, or the block its first generated token
+lands in) is always freshly allocated. The engine caps lookups at
+``(len(prompt) - 1) // block_size`` blocks so the chunk stream always
+keeps at least the final prompt token: its forward pass is what produces
+the first sampled token's logits. Insertion into this cache happens only
+when a prompt's LAST chunk has run (``engine._finish_prefill``) — an
+entry can never hand another admission blocks whose KV is still pending
+in the chunk stream.
 
 A parameter hot-swap flushes the cache wholesale (``serve/hotswap.py``):
 KV computed under the old round's params is invalid under the new one.

@@ -3,11 +3,19 @@
 The serving loop (one driver thread) interleaves two phases forever:
 
 1. **admit** — pop FIFO from the bounded queue into free slots while the
-   paged pool can cover each request's worst-case block reservation, up to
-   ``prefill_token_budget`` prompt tokens per iteration (one over-budget
-   prompt still admits alone — the budget bounds *batching* of prefills,
-   not admissibility, so a giant prompt can't starve in-flight decodes);
-2. **decode** — ONE fixed-shape engine step for every active slot; rows
+   paged pool can cover each request's worst-case block reservation.
+   Admission itself is CHEAP (``engine.begin``: reserve blocks, install
+   the table row — no model compute); the prompt's tokens then prefill
+   through the step phase's chunk stream;
+2. **step** — ONE unified mixed chunked-prefill engine step
+   (``engine.mixed_step``): every decoding slot advances one token AND
+   the oldest prefilling request's next prompt chunk — at most
+   ``prefill_token_budget`` tokens — rides in the same program. A prompt
+   larger than the budget is SPLIT across consecutive steps, so decode
+   cadence (TPOT) is bounded by one budget-sized chunk, never by a whole
+   giant prompt (the PR 5 "one over-budget prompt admits alone" carve-out
+   let a single 4x-budget prompt stall every in-flight decode for its
+   full prefill — ``tests/test_ragged_attention.py`` pins the fix). Rows
    that hit their EOS or ``max_new_tokens`` are evicted immediately and
    their blocks/slot recycled, so the next iteration's admit phase refills
    mid-flight. That refill is the whole tokens/s win over batch-synchronous
@@ -41,6 +49,12 @@ from photon_tpu.metrics.history import History
 from photon_tpu.serve.engine import PagedEngine
 from photon_tpu.utils.profiling import (
     EVENT_HOTSWAP_SWAPPED,
+    SERVE_ATTN_CTX_BLOCKS,
+    SERVE_ATTN_LIVE_FRAC,
+    SERVE_ATTN_RAGGED,
+    SERVE_CHUNK_SPLIT_PROMPTS,
+    SERVE_CHUNK_STEPS,
+    SERVE_CHUNK_TOKENS,
     SERVE_COMPILES_TOTAL,
     SERVE_DECODE_SPAN,
     SERVE_EVICTIONS,
@@ -151,6 +165,12 @@ class ContinuousBatcher:
         self.evictions = 0
         self.completed = 0
         self.swaps = 0
+        # chunked-prefill counters (ISSUE 12): steps that carried a
+        # chunk, tokens prefilled through the chunk stream, prompts whose
+        # suffix exceeded one budget (the split that protects TPOT)
+        self.chunk_steps = 0
+        self.chunk_tokens = 0
+        self.chunk_split_prompts = 0
         # live checkpoint hot-swap (ISSUE 11): (params, round, done-event,
         # t_request) staged by request_swap, applied by the driver thread
         # at the swap point — between decode steps, with zero active slots
@@ -329,12 +349,21 @@ class ContinuousBatcher:
                 SERVE_EVICTIONS: float(self.evictions),
                 SERVE_REJECTED: float(self.rejected),
                 SERVE_HOTSWAP_SWAPS_TOTAL: float(self.swaps),
+                SERVE_CHUNK_STEPS: float(self.chunk_steps),
+                SERVE_CHUNK_TOKENS: float(self.chunk_tokens),
+                SERVE_CHUNK_SPLIT_PROMPTS: float(self.chunk_split_prompts),
             }
             # getattr: fake/minimal engines (tests, alternative backends)
             # need not carry the checkpoint- or prefix-plane attributes
             rnd = getattr(self.engine, "loaded_round", None)
             if rnd is not None:
                 out[SERVE_HOTSWAP_ROUND] = float(rnd)
+            attn = getattr(self.engine, "attn_stats", None)
+            if attn is not None:
+                a = attn()
+                out[SERVE_ATTN_CTX_BLOCKS] = a["ctx_blocks"]
+                out[SERVE_ATTN_LIVE_FRAC] = a["live_frac"]
+                out[SERVE_ATTN_RAGGED] = a["ragged"]
         pc = getattr(self.engine, "prefix_cache", None)
         if pc is not None:
             out[SERVE_PREFIX_HIT_RATE] = pc.hit_rate
@@ -355,7 +384,7 @@ class ContinuousBatcher:
             try:
                 self._maybe_swap()
                 self._admit_phase()
-                self._decode_phase()
+                self._step_phase()
             except Exception as e:  # noqa: BLE001 — fail loudly, not silently
                 self._fail_all(f"{type(e).__name__}: {e}")
             self._record_tick()
@@ -373,10 +402,8 @@ class ContinuousBatcher:
         if self.swap_pending:
             # quiesce toward the swap point: nothing new starts on params
             # about to be replaced; queued requests wait (never dropped)
-            # and running slots drain through the decode phase
+            # and running slots drain through the step phase
             return
-        budget = self.prefill_token_budget
-        admitted_any = False
         # batch-sync baseline: a wave may only START from an empty engine,
         # but once open it fills EVERY slot this phase (admissions made
         # here keep n_active > 0 — checking n_active per iteration would
@@ -389,8 +416,6 @@ class ContinuousBatcher:
                 return
             if self.batch_synchronous and not wave_open:
                 return  # baseline: wait for the whole wave to drain
-            if admitted_any and budget < len(head.prompt):
-                return  # interleave: give decode a turn before more prefills
             slot = self.engine.free_slot()
             if slot is None or not self.engine.can_admit(
                 len(head.prompt), head.max_new_tokens, prompt=head.prompt
@@ -400,12 +425,15 @@ class ContinuousBatcher:
                 req = self._queue.popleft()
             req.t_admit = time.monotonic()
             try:
-                first = self.engine.admit(
+                # admission is the CHEAP half now (reserve + table row):
+                # the prompt itself prefills through the step phase's
+                # chunk stream, budget-bounded per step
+                self.engine.begin(
                     slot, req.prompt, req.max_new_tokens,
                     temperature=req.temperature, seed=req.seed,
                 )
             except Exception as e:  # noqa: BLE001 — fail THIS request, keep serving
-                # engine.admit is transactional (blocks freed, slot released)
+                # engine.begin is transactional (blocks freed, slot released)
                 # — only this request dies, and its client gets the error
                 # instead of a timeout
                 req.finished = True
@@ -414,27 +442,47 @@ class ContinuousBatcher:
                 self._emit_spans(req)
                 req._out.put(None)
                 continue
-            req.t_first = time.monotonic()
             self.admitted_order.append(req.rid)
             with self._lock:
                 self._running[slot] = req
-            budget -= len(req.prompt)
-            admitted_any = True
-            self._push_token(slot, req, first)
+            if self.engine.pending_tokens(slot) > self.prefill_token_budget:
+                self.chunk_split_prompts += 1
 
-    def _decode_phase(self) -> None:
+    def _step_phase(self) -> None:
+        """One mixed chunked-prefill step: all decoding slots advance one
+        token; the OLDEST prefilling request (FIFO by rid — admission
+        order) contributes its next chunk, at most
+        ``prefill_token_budget`` tokens. Chunks serialize across
+        requests (one prompt chunks at a time — its chunk widths then
+        depend only on its own length and the budget, which is what
+        keeps the step-shape bucket set deterministic), while decode
+        rows ride along EVERY step: a giant prompt can delay a decode
+        token by one chunk, never by a whole prefill."""
         with self._lock:
-            slots = sorted(self._running)
-        if not slots:
+            running = dict(self._running)
+        if not running:
             return
+        chunk = None
+        prefilling = [(slot, req) for slot, req in running.items()
+                      if self.engine.pending_tokens(slot) > 0]
+        if prefilling:
+            slot, _ = min(prefilling, key=lambda it: it[1].rid)
+            chunk = (slot, min(self.engine.pending_tokens(slot),
+                               self.prefill_token_budget))
+            self.chunk_steps += 1
+            self.chunk_tokens += chunk[1]
         t0 = time.monotonic()
-        nxt = self.engine.step()
+        nxt, emitted = self.engine.mixed_step(chunk)
         dt = time.monotonic() - t0
         n_tokens = 0
-        for slot in slots:
+        for slot in sorted(running):
+            if not emitted[slot]:
+                continue  # mid-prefill: nothing to stream yet
             req = self._running.get(slot)
             if req is None or req.finished:
                 continue
+            if not req.generated:
+                req.t_first = time.monotonic()  # the request's FIRST token
             n_tokens += 1
             self._push_token(slot, req, int(nxt[slot]))
         if dt > 0 and n_tokens:
@@ -503,6 +551,16 @@ class ContinuousBatcher:
             hub.counter(SERVE_REJECTED).inc_to(stats[SERVE_REJECTED])
             hub.counter(SERVE_HOTSWAP_SWAPS_TOTAL).inc_to(
                 stats[SERVE_HOTSWAP_SWAPS_TOTAL])
+            hub.counter(SERVE_CHUNK_STEPS).inc_to(stats[SERVE_CHUNK_STEPS])
+            hub.counter(SERVE_CHUNK_TOKENS).inc_to(stats[SERVE_CHUNK_TOKENS])
+            hub.counter(SERVE_CHUNK_SPLIT_PROMPTS).inc_to(
+                stats[SERVE_CHUNK_SPLIT_PROMPTS])
+            if SERVE_ATTN_CTX_BLOCKS in stats:
+                hub.gauge(SERVE_ATTN_CTX_BLOCKS).set(
+                    stats[SERVE_ATTN_CTX_BLOCKS])
+                hub.gauge(SERVE_ATTN_LIVE_FRAC).set(
+                    stats[SERVE_ATTN_LIVE_FRAC])
+                hub.gauge(SERVE_ATTN_RAGGED).set(stats[SERVE_ATTN_RAGGED])
             if SERVE_HOTSWAP_ROUND in stats:
                 hub.gauge(SERVE_HOTSWAP_ROUND).set(stats[SERVE_HOTSWAP_ROUND])
             if SERVE_PREFIX_HIT_RATE in stats:

@@ -175,6 +175,26 @@ SERVE_HOTSWAP_ROUND = "serve/hotswap_round"
 # span-only: the swap window (request → reference assignment)
 SERVE_HOTSWAP_SWAP_SPAN = "serve/hotswap_swap"
 
+# -- ragged paged attention + chunked prefill (ISSUE 12) ------------------
+# Attention-plane gauges (tick-time, from PagedEngine.attn_stats):
+#: the live attention walk width in BLOCKS (the monotone high-water
+#: pow2 bucket; == the full table width under attention_impl=gather)
+SERVE_ATTN_CTX_BLOCKS = "serve/attn_ctx_blocks"
+#: fraction of the paged pool's blocks currently allocated (live KV —
+#: the x-axis of the bench's tokens/s-vs-occupancy curve)
+SERVE_ATTN_LIVE_FRAC = "serve/attn_live_frac"
+#: 1.0 when the ragged live-block walk is active, 0.0 under the
+#: full-width dense-gather oracle path (attention_impl=gather)
+SERVE_ATTN_RAGGED = "serve/attn_ragged"
+# Chunked-prefill counters (scheduler-owned, cumulative):
+#: scheduler steps that carried a prompt chunk alongside decode rows
+SERVE_CHUNK_STEPS = "serve/chunk_steps_total"
+#: prompt tokens prefilled through the chunk stream
+SERVE_CHUNK_TOKENS = "serve/chunk_tokens_total"
+#: prompts that needed more than one chunk (suffix > the per-step
+#: token budget — the giant prompts that used to monopolize a step)
+SERVE_CHUNK_SPLIT_PROMPTS = "serve/chunk_split_prompts_total"
+
 # -- run-health observatory instruments (ISSUE 10, telemetry/metrics.py) --
 # Histogram instruments on the serve plane (typed-metric hub, NOT History
 # KPIs: a latest-value gauge can't show a distribution):

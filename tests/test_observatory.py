@@ -722,10 +722,15 @@ def test_compile_counter_counts_real_jax_compiles():
 
 
 class FakeEngine:
+    """Chunked-flow engine stub (ISSUE 12 interface: begin + mixed_step):
+    every prompt 'prefills' in one fake chunk whose emission is token 1,
+    decode rows emit token 2."""
+
     n_slots = 2
 
     def __init__(self):
         self._active = {}
+        self._pending = {}
 
     @property
     def n_active(self):
@@ -740,15 +745,32 @@ class FakeEngine:
     def free_slot(self):
         return next((s for s in range(self.n_slots) if s not in self._active), None)
 
-    def admit(self, slot, prompt, max_new, temperature=0.0, seed=0):
+    def begin(self, slot, prompt, max_new, temperature=0.0, seed=0):
         self._active[slot] = True
-        return 1
+        self._pending[slot] = len(prompt)
 
-    def step(self):
-        return [2] * self.n_slots
+    def pending_tokens(self, slot):
+        return self._pending.get(slot, 0)
+
+    def mixed_step(self, chunk=None, include_decode=True):
+        import numpy as np
+
+        nxt = np.zeros(self.n_slots, np.int32)
+        emitted = np.zeros(self.n_slots, bool)
+        chunk_slot = None
+        if chunk is not None:
+            chunk_slot = chunk[0]
+            self._pending.pop(chunk_slot, None)
+            nxt[chunk_slot], emitted[chunk_slot] = 1, True
+        if include_decode:
+            for s in list(self._active):
+                if s != chunk_slot and s not in self._pending:
+                    nxt[s], emitted[s] = 2, True
+        return nxt, emitted
 
     def evict(self, slot):
         self._active.pop(slot, None)
+        self._pending.pop(slot, None)
 
 
 def test_scheduler_observes_request_histograms():

@@ -219,24 +219,27 @@ def test_scheduler_invariants_random_streams(served):
 
 
 def test_failed_admission_is_transactional(served):
-    """A prefill blow-up mid-admission fails THAT request (client gets the
-    error, not a timeout), leaks no blocks, and the server keeps serving."""
+    """A chunk-step blow-up mid-prefill fails the in-flight request (the
+    client gets the error, not a timeout), leaks no blocks — the failure
+    is injected at the engine's device-call seam, BEFORE the donated
+    state is consumed, so the engine survives — and the server keeps
+    serving the queue afterwards."""
     cfg, params, engine, batcher = served
-    real = engine._prefill_jit
+    real = engine._mixed_call
     calls = {"n": 0}
 
     def boom(*a, **k):
         calls["n"] += 1
         raise RuntimeError("injected prefill failure")
 
-    engine._prefill_jit = boom
+    engine._mixed_call = boom
     try:
         req = batcher.submit([5, 9, 2], 4)
         with pytest.raises(RuntimeError, match="injected prefill failure"):
             req.result(timeout=60)
     finally:
-        engine._prefill_jit = real
-    assert calls["n"] == 1
+        engine._mixed_call = real
+    assert calls["n"] >= 1
     _assert_drained(engine, batcher)
     ok = batcher.submit([5, 9, 2], 4).result(timeout=60)  # still serving
     assert ok == _offline_greedy(cfg, params, [5, 9, 2], 4)
@@ -265,6 +268,23 @@ def test_steady_state_serving_never_retraces(served):
         reqs = [batcher.submit(p, n) for p, n in zip(prompts, budgets)]
         outs = [r.result(timeout=120) for r in reqs]
     assert sentinel.violations == []
+    # ... and again with CHUNKED prefill (ISSUE 12): a small per-step
+    # token budget splits every prompt into multi-chunk mixed batches.
+    # The chunk widths depend only on each prompt's length and the
+    # budget, so one unguarded warm pass covers every (Tq, n_ctx)
+    # bucket the guarded pass can produce
+    batcher.prefill_token_budget = 3
+    try:
+        for r in [batcher.submit(p, n) for p, n in zip(prompts, budgets)]:
+            r.result(timeout=120)  # warm the chunk buckets
+        with lint_rt.retrace_guard(steady=True) as sentinel:
+            reqs2 = [batcher.submit(p, n) for p, n in zip(prompts, budgets)]
+            for r in reqs2:
+                r.result(timeout=120)
+        assert sentinel.violations == []
+        assert batcher.chunk_split_prompts > 0  # chunking genuinely happened
+    finally:
+        batcher.prefill_token_budget = 2048
     # the offline oracle runs OUTSIDE the guard: its contiguous decode
     # buffers are shaped per (prompt+n) and legitimately compile fresh
     for p, out in zip(prompts, outs):
