@@ -1,7 +1,7 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test test-all bench bench-host bench-telemetry bench-collective bench-ragged bench-compare chaos chaos-collective telemetry-smoke serve-smoke lint lint-tests native clean
+.PHONY: test test-all bench bench-host bench-telemetry bench-collective bench-ragged bench-compare chaos chaos-collective telemetry-smoke serve-smoke adapters-smoke lint lint-tests native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
@@ -93,6 +93,23 @@ serve-smoke: lint
 		tests/test_serve.py tests/test_serve_prefix.py tests/test_hotswap.py \
 		tests/test_ragged_attention.py -q -m "slow or not slow"
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --serving
+
+# per-cohort LoRA personalization plane (ISSUE 13): the train-side suite
+# (config validation, LoRA payload algebra, fused multi-cohort reduction
+# vs the per-cohort host oracle at off + pinned q8 bound, federated
+# adapter rounds with frozen-base/cohort-degradation/checkpoint-resume
+# pins) and the serve-side suite (adapter-pool refcounts, mixed-cohort
+# bit-parity vs the contiguous base+adapter oracle incl. recycled pages,
+# cohort over HTTP, retrace sentinel over cohort churn, and the
+# train→checkpoint→hot-swap e2e with zero dropped requests) — then the
+# bench gate: modeled adapter wire bytes >= 50x below a full-model
+# exchange and the fused K-cohort reduction beating K sequential
+# reductions. Both suites ride tier-1 too (none is slow); lint preflight
+# first like the other smoke targets.
+adapters-smoke: lint
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_adapters.py tests/test_adapter_serve.py -q -m "slow or not slow"
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --adapters
 
 # the chaos-marked fault-injection + elasticity suite (incl. the slow
 # SIGKILL/rejoin e2es): deterministic — every test pins

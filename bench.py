@@ -2144,6 +2144,173 @@ def collective_report(n_clients: int = 4, replica: int = 2,
         return None
 
 
+def adapter_plane_report(n_clients: int = 8, n_cohorts: int = 4,
+                         rank: int = 8, repeats: int = 3) -> dict | None:
+    """Per-cohort LoRA personalization plane (ISSUE 13): the two headline
+    numbers, both exit-code gated by ``--adapters``.
+
+    - ``wire_bytes_reduction``: modeled cross-slice bytes of one FULL
+      125M-shaped model exchange vs one adapter exchange for the SAME
+      client count (each client ships only its rank-``rank`` A/B factors)
+      — the "adapter deltas are ~1000x smaller" claim, gated at ≥ 50x.
+    - ``fused_speedup``: wall time of ONE grouped program reducing ALL
+      ``n_cohorts`` cohorts (``grouped_weighted_average``) vs K
+      sequential full-mesh reductions (one cohort-masked
+      ``hierarchical_weighted_average`` per cohort — the obvious
+      implementation the grouped program replaces). Same per-element
+      work either way; the fused win is K−1 saved rendezvous/dispatches,
+      gated at > 1x. ABBA-ordered best-of-``repeats``.
+
+    Needs ``n_clients`` CPU devices configured BEFORE jax initializes —
+    standalone (``--adapters``) or via :func:`adapter_subprocess_report`.
+    """
+    try:
+        import numpy as np
+
+        from photon_tpu.utils.compat import set_cpu_device_count
+
+        set_cpu_device_count(n_clients)
+        import jax
+
+        if jax.device_count() < n_clients:
+            log(f"adapter report needs {n_clients} devices, have "
+                f"{jax.device_count()} (backend initialized early?)")
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from photon_tpu.adapters.lora import (
+            adapter_metadata, spec_from_base,
+        )
+        from photon_tpu.codec import ParamsMetadata, flatten_params
+        from photon_tpu.config.schema import AdaptersConfig, ModelConfig
+        from photon_tpu.models.mpt import init_params
+        from photon_tpu.parallel.collective_agg import (
+            CLIENT_AXIS,
+            grouped_weighted_average,
+            hierarchical_weighted_average,
+            make_hierarchical_mesh,
+            modeled_cross_slice_bytes,
+        )
+
+        # 125M-shaped base metadata (eval_shape: no weights materialize)
+        abstract = jax.eval_shape(lambda: init_params(ModelConfig(), seed=0))
+        names, leaves = flatten_params(abstract)
+        base_meta = ParamsMetadata(
+            names=tuple(names),
+            shapes=tuple(tuple(int(d) for d in l.shape) for l in leaves),
+            dtypes=tuple("float32" for _ in names),
+        )
+        base_sizes = [int(np.prod(s, dtype=np.int64)) for s in base_meta.shapes]
+        spec = spec_from_base(
+            base_meta, rank, 16.0, tuple(AdaptersConfig().targets)
+        )
+        ameta = adapter_metadata(spec)
+        adapter_sizes = [int(np.prod(s, dtype=np.int64)) for s in ameta.shapes]
+        full_bytes = modeled_cross_slice_bytes(base_sizes, n_clients)
+        adapter_bytes = modeled_cross_slice_bytes(adapter_sizes, n_clients)
+
+        # real adapter-shaped payloads for the timing half (the REAL 125M
+        # adapter shapes: ~spec.n_params fp32 per client)
+        rng = np.random.default_rng(0)
+        mesh = make_hierarchical_mesh(n_clients, 1)
+        sharding = NamedSharding(mesh, P(CLIENT_AXIS))
+        stacked = [
+            jax.device_put(
+                rng.normal(0, 0.02, (n_clients,) + tuple(s)).astype(np.float32),
+                sharding,
+            )
+            for s in ameta.shapes
+        ]
+        ns = rng.integers(64, 512, n_clients).astype(np.int32)
+        onehot = np.zeros((n_clients, n_cohorts), np.float32)
+        for c in range(n_clients):
+            onehot[c, c % n_cohorts] = 1.0
+        ns_dev = jax.device_put(ns, sharding)
+        oh_dev = jax.device_put(onehot, sharding)
+
+        def fused_once():
+            avgs, totals = grouped_weighted_average(
+                stacked, ns_dev, oh_dev, mesh
+            )
+            jax.block_until_ready(totals)
+
+        # sequential baseline: one full-mesh reduction per cohort with
+        # every other cohort's weight zeroed (same program each time —
+        # only the ns values change, so the comparison is pure dispatch/
+        # rendezvous count, never compile time)
+        ns_masked = [
+            jax.device_put((ns * onehot[:, k]).astype(np.int32), sharding)
+            for k in range(n_cohorts)
+        ]
+
+        def sequential_once():
+            last = None
+            for k in range(n_cohorts):
+                last = hierarchical_weighted_average(
+                    stacked, ns_masked[k], mesh
+                )
+            jax.block_until_ready(last)
+
+        fused_once()  # warmup: grouped program compile
+        sequential_once()  # warmup: plain program compile
+        best = {"fused": None, "sequential": None}
+        for fn, key in ((fused_once, "fused"), (sequential_once, "sequential"),
+                        (sequential_once, "sequential"), (fused_once, "fused"),
+                        (fused_once, "fused"), (sequential_once, "sequential")):
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                fn()
+            dt = (time.perf_counter() - t0) / repeats
+            best[key] = dt if best[key] is None else min(best[key], dt)
+
+        return {
+            "n_clients": n_clients,
+            "n_cohorts": n_cohorts,
+            "rank": rank,
+            "adapter_params_per_cohort": spec.n_params,
+            "base_params": int(sum(base_sizes)),
+            "modeled_full_exchange_bytes": int(full_bytes),
+            "modeled_adapter_exchange_bytes": int(adapter_bytes),
+            "wire_bytes_reduction": round(full_bytes / adapter_bytes, 1),
+            "fused_wall_s": round(best["fused"], 5),
+            "sequential_wall_s": round(best["sequential"], 5),
+            "fused_speedup": round(best["sequential"] / best["fused"], 3),
+        }
+    except Exception as e:  # noqa: BLE001 — never cost the round its numbers
+        log(f"adapter report failed: {type(e).__name__}: {e}")
+        return None
+
+
+def _child_report(flag: str, key: str, timeout: int) -> dict | None:
+    """Run ``bench.py {flag}`` in a child CPU interpreter and return the
+    ``key`` object from its JSON line — the bridge for reports whose
+    emulated device mesh must be configured before jax initializes (this
+    process's backend is already up by report time, possibly on TPU)."""
+    try:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""  # never contend for the tunneled chip
+        proc = subprocess.run(
+            [sys.executable, str(HERE / "bench.py"), flag],
+            capture_output=True, text=True, timeout=timeout, env=env,
+        )
+        obj = _scan_json(proc.stdout, lambda o: o.get(key))
+        if obj is None:
+            log(f"{key} child produced no report (rc {proc.returncode}):"
+                f" {proc.stderr[-300:]}")
+            return None
+        return obj[key]
+    except Exception as e:  # noqa: BLE001 — never cost the round its numbers
+        log(f"{key} report failed: {type(e).__name__}: {e}")
+        return None
+
+
+def adapter_subprocess_report(timeout: int = 900) -> dict | None:
+    """In-run bridge for :func:`adapter_plane_report` (the emulated client
+    mesh must exist before jax initializes)."""
+    return _child_report("--adapters", "adapters", timeout)
+
+
 # ---------------------------------------------------------------------------
 # Bench regression harness (ISSUE 10 satellite): BENCH_r*.json as a GATE
 # ---------------------------------------------------------------------------
@@ -2188,6 +2355,9 @@ _COMPARE_GATES = (
     (lambda p: _dig(p, ("value",)), "train_tokens_per_sec", True),
     (_serving_tps, "serving_tokens_per_s", False),
     (_ragged_low_occ_tps, "serving_ragged_low_occ_tokens_per_s", False),
+    # fused-grouped-reduction win over K sequential reductions (ISSUE 13)
+    (lambda p: _dig(p, ("adapters", "fused_speedup")),
+     "adapters_fused_speedup", False),
 )
 
 
@@ -2289,28 +2459,9 @@ def compare_main(old_path: str, new_path: str) -> int:
 
 
 def collective_subprocess_report(timeout: int = 900) -> dict | None:
-    """In-run bridge for :func:`collective_report`: the 8-device CPU
-    emulation must be configured before jax initializes, and by report time
-    this process's backend is already up (possibly on TPU) — so the report
-    runs in a child interpreter and ships back as the ``--collective`` JSON
-    line."""
-    try:
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["PALLAS_AXON_POOL_IPS"] = ""  # never contend for the tunneled chip
-        proc = subprocess.run(
-            [sys.executable, str(HERE / "bench.py"), "--collective"],
-            capture_output=True, text=True, timeout=timeout, env=env,
-        )
-        obj = _scan_json(proc.stdout, lambda o: o.get("collective"))
-        if obj is None:
-            log(f"collective child produced no report (rc {proc.returncode}):"
-                f" {proc.stderr[-300:]}")
-            return None
-        return obj["collective"]
-    except Exception as e:  # noqa: BLE001 — never cost the round its numbers
-        log(f"collective report failed: {type(e).__name__}: {e}")
-        return None
+    """In-run bridge for :func:`collective_report` (the 8-device CPU
+    emulation must be configured before jax initializes)."""
+    return _child_report("--collective", "collective", timeout)
 
 
 # ---------------------------------------------------------------------------
@@ -2689,6 +2840,16 @@ def run(platform: str) -> None:
             out["collective"] = cr
             emit(out)
 
+    # per-cohort LoRA personalization plane (ISSUE 13): modeled adapter-vs-
+    # full-model wire bytes + the fused-grouped-reduction win over K
+    # sequential reductions (own child interpreter, same reasoning as the
+    # collective report)
+    if os.environ.get("PHOTON_BENCH_SKIP_ADAPTERS") != "1":
+        ar = adapter_subprocess_report()
+        if ar is not None:
+            out["adapters"] = ar
+            emit(out)
+
     # under the supervisor (PHOTON_BENCH_ORCHESTRATED) parity and the
     # evidence stages run in their own child processes with fresh relay
     # claims; inline execution remains for manual `--run` invocations
@@ -2825,6 +2986,11 @@ def main() -> int:
                          "TPOT) and print {'serving_ragged': ...}; exits "
                          "nonzero unless ragged wins at low occupancy and "
                          "chunking cuts the worst decode gap")
+    ap.add_argument("--adapters", action="store_true",
+                    help="per-cohort LoRA plane gate (ISSUE 13): modeled "
+                         "adapter wire bytes >= 50x below a full-model "
+                         "exchange AND the fused K-cohort reduction beats "
+                         "K sequential reductions (CPU-only)")
     ap.add_argument("--collective", action="store_true",
                     help="run only the device-collective aggregation report "
                          "(flat fp32 vs hierarchical q8 on an emulated CPU "
@@ -2886,6 +3052,19 @@ def main() -> int:
         gap_ratio = ((rg or {}).get("chunked_tpot") or {}).get("gap_ratio")
         return 0 if (ragged_gain and ragged_gain > 1.0
                      and gap_ratio and gap_ratio > 1.0) else 1
+    if args.adapters:
+        # CPU-jax only, fresh backend (the emulated client mesh must be
+        # configured before jax initializes — the in-run bench reaches
+        # this path through adapter_subprocess_report). Exit gate
+        # (ISSUE 13): adapter wire bytes >= 50x below the full-model
+        # exchange AND the fused grouped reduction beats K sequential
+        # reductions.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        ar = adapter_plane_report()
+        emit({"adapters": ar})
+        return 0 if (ar is not None
+                     and ar.get("wire_bytes_reduction", 0.0) >= 50.0
+                     and ar.get("fused_speedup", 0.0) > 1.0) else 1
     if args.collective:
         # CPU-jax only, fresh backend — the emulated client mesh must be
         # configured before jax initializes, which is why the in-run bench

@@ -1,0 +1,56 @@
+"""Federated per-cohort LoRA personalization plane (ISSUE 13).
+
+One frozen federated base + thousands of tiny per-cohort adapters,
+trained federated (``adapters/federated.py`` +
+``federation/collective_round.py``'s grouped rounds) and served
+multi-tenant (``serve/adapter_pool.py`` + the engine's per-slot gather).
+See ``docs/personalization.md``.
+"""
+
+from photon_tpu.adapters.checkpoint import (
+    adapter_key,
+    adapter_state_key,
+    adapter_state_keys,
+    load_adapter_bank,
+)
+from photon_tpu.adapters.federated import (
+    AdapterTrainPlane,
+    configure_adapter_training,
+)
+from photon_tpu.adapters.lora import (
+    BASE_FREEZE_PATTERN,
+    AdapterSpec,
+    adapter_metadata,
+    adapter_tree,
+    cohort_seed,
+    init_adapter_arrays,
+    is_adapter_name,
+    merge_adapter_into_base,
+    merge_payload,
+    spec_from_base,
+    spec_from_params,
+    split_adapter,
+    stack_adapter_trees,
+)
+
+__all__ = [
+    "AdapterSpec",
+    "AdapterTrainPlane",
+    "BASE_FREEZE_PATTERN",
+    "adapter_key",
+    "adapter_metadata",
+    "adapter_state_key",
+    "adapter_state_keys",
+    "adapter_tree",
+    "cohort_seed",
+    "configure_adapter_training",
+    "init_adapter_arrays",
+    "is_adapter_name",
+    "load_adapter_bank",
+    "merge_adapter_into_base",
+    "merge_payload",
+    "spec_from_base",
+    "spec_from_params",
+    "split_adapter",
+    "stack_adapter_trees",
+]

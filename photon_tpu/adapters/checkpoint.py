@@ -1,0 +1,53 @@
+"""Adapter checkpoint layout over the PR 3 manifest machinery (ISSUE 13).
+
+A personalization round checkpoints exactly like a plain federated round —
+``ServerCheckpointManager.save_round`` with extra ``strategy_state``
+entries, so the manifest CRCs, torn-round detection, GC and the hot-swap
+watcher's ``latest_complete_round`` all apply unchanged:
+
+    {run}/server/{round}/current_server_parameters.npz   ← the FROZEN base
+    {run}/server/{round}/adapter__{cohort}.npz           ← cohort adapters
+    {run}/server/{round}/astate__{cohort}__{key}.npz     ← cohort optimizer
+    {run}/server/{round}/state.bin                       ← control state
+    {run}/server/{round}/manifest.json                   ← written LAST
+
+The serving side reads the base (params-only load) plus the
+``adapter__*`` objects — never the pickled control state or optimizer
+moments.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+ADAPTER_KEY_PREFIX = "adapter__"
+ADAPTER_STATE_PREFIX = "astate__"
+
+
+def adapter_key(cohort: str) -> str:
+    return f"{ADAPTER_KEY_PREFIX}{cohort}"
+
+
+def adapter_state_key(cohort: str, state_key: str) -> str:
+    return f"{ADAPTER_STATE_PREFIX}{cohort}__{state_key}"
+
+
+def adapter_state_keys(cohorts, strategy_state_keys) -> tuple[str, ...]:
+    """Every per-cohort npz key a round writes — the ``state_keys`` list
+    validity/resume checks need."""
+    out = [adapter_key(c) for c in sorted(cohorts)]
+    for c in sorted(cohorts):
+        out.extend(adapter_state_key(c, k) for k in strategy_state_keys)
+    return tuple(out)
+
+
+def load_adapter_bank(mgr, server_round: int, cohorts
+                      ) -> dict[str, list[np.ndarray]]:
+    """Read every cohort's adapter arrays from a round (adapter objects
+    only — no optimizer moments, no pickled state). ``cohorts`` is the
+    config's cohort map (names are what matter)."""
+    bank: dict[str, list[np.ndarray]] = {}
+    for cohort in sorted(cohorts):
+        _, arrays = mgr.load_state_npz(server_round, adapter_key(cohort))
+        bank[cohort] = arrays
+    return bank

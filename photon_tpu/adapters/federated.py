@@ -1,0 +1,128 @@
+"""Train-side adapter plane: config wiring + per-cohort payload plumbing.
+
+The glue between ``photon.adapters`` and the collective runner
+(``federation/collective_round.py``):
+
+- :func:`configure_adapter_training` derives the model's LoRA knobs and
+  the base-freeze pattern from the adapters block (one source of truth —
+  an operator enables ``photon.adapters`` and the trainer-side plumbing
+  follows);
+- :class:`AdapterTrainPlane` owns the frozen base payload, the per-cohort
+  broadcast assembly, the adapter-row extraction from fit results, and
+  the per-cohort server strategies (``strategy/grouped.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from photon_tpu.adapters.lora import (
+    BASE_FREEZE_PATTERN,
+    adapter_metadata,
+    cohort_seed,
+    init_adapter_arrays,
+    merge_payload,
+    spec_from_base,
+    split_adapter,
+)
+from photon_tpu.codec import ParamsMetadata
+from photon_tpu.config.schema import Config
+from photon_tpu.strategy.grouped import CohortStrategies, cohort_of_map
+
+
+def configure_adapter_training(cfg: Config) -> Config:
+    """Derive the trainer-side knobs from ``photon.adapters`` (idempotent;
+    called by the collective runner BEFORE any Trainer is built):
+
+    - ``model.lora_rank/alpha/targets`` — the training model grows the
+      A/B params (``models/mpt.py``);
+    - ``optimizer.freeze_patterns`` += the base-freeze pattern — every
+      non-adapter param gets exactly-zero updates (``optax.set_to_zero``
+      via multi_transform), keeping the federated base off the optimizer
+      and off the wire.
+    """
+    ad = cfg.photon.adapters
+    if not ad.enabled:
+        return cfg
+    cfg.model.lora_rank = int(ad.rank)
+    cfg.model.lora_alpha = float(ad.alpha)
+    cfg.model.lora_targets = tuple(ad.targets)
+    if BASE_FREEZE_PATTERN not in cfg.optimizer.freeze_patterns:
+        cfg.optimizer.freeze_patterns = list(cfg.optimizer.freeze_patterns) + [
+            BASE_FREEZE_PATTERN
+        ]
+    return cfg
+
+
+class AdapterTrainPlane:
+    """Host-side state of a personalization run: the frozen base, one
+    adapter + server-optimizer state per cohort, and the flat-payload
+    plumbing between them and the training model."""
+
+    def __init__(self, cfg: Config, base_meta: ParamsMetadata,
+                 base_arrays: list[np.ndarray]) -> None:
+        ad = cfg.photon.adapters
+        if not ad.cohorts:
+            raise ValueError(
+                "photon.adapters.enabled needs a non-empty cohorts map "
+                "(cohort name -> [cid, ...])"
+            )
+        self.base_meta = base_meta
+        self.base_arrays = [np.asarray(a, np.float32) for a in base_arrays]
+        self.spec = spec_from_base(
+            base_meta, ad.rank, ad.alpha, tuple(ad.targets)
+        )
+        self.ameta = adapter_metadata(self.spec)
+        self.cohort_of = cohort_of_map(ad.cohorts)
+        self.strategies = CohortStrategies(cfg.fl, ad.cohorts.keys())
+        self.cohort_names = self.strategies.names
+        self.strategies.initialize({
+            name: init_adapter_arrays(self.spec, cohort_seed(cfg.seed, name))[1]
+            for name in self.cohort_names
+        })
+        # cohortless clients (cids outside every cohort) broadcast a FRESH
+        # identity adapter each round: they train, but nobody aggregates
+        # them — deliberate (personalization is per cohort; add the cid to
+        # a cohort to keep its work)
+        self._identity = init_adapter_arrays(
+            self.spec, cohort_seed(cfg.seed, "")
+        )[1]
+
+    @property
+    def n_cohorts(self) -> int:
+        return len(self.cohort_names)
+
+    def adapter_sizes(self) -> list[int]:
+        """Per-leaf element counts of one adapter payload (the modeled
+        wire unit — what crosses DCN instead of the full model)."""
+        return [int(np.prod(s, dtype=np.int64)) for s in self.ameta.shapes]
+
+    def broadcast_payload(self, cid: int
+                          ) -> tuple[ParamsMetadata, list[np.ndarray]]:
+        """Base + this client's cohort adapter as ONE canonical payload
+        (what the lora-enabled trainer's ``set_parameters`` consumes)."""
+        name = self.cohort_of.get(int(cid))
+        adapter = (self.strategies.params(name) if name is not None
+                   else self._identity)
+        return merge_payload(self.base_meta, self.base_arrays,
+                             self.ameta, adapter)
+
+    def extract_adapter(self, meta: ParamsMetadata,
+                        arrays: list[np.ndarray]) -> list[np.ndarray]:
+        """Fit result (full payload) → the adapter rows alone, validated
+        against the spec — the ONLY tensors that ever reach the exchange
+        (the frozen base never moves)."""
+        _, _, ameta, aarrays = split_adapter(meta, arrays)
+        if ameta.names != self.ameta.names:
+            raise ValueError(
+                "fit result's adapter names do not match the plane's spec; "
+                f"first diff: {_first_diff(ameta.names, self.ameta.names)}"
+            )
+        return aarrays
+
+
+def _first_diff(a, b) -> str:
+    for x, y in zip(a, b):
+        if x != y:
+            return f"{x!r} vs {y!r}"
+    return "length mismatch"

@@ -1,0 +1,237 @@
+"""LoRA adapter math on the codec's canonical (metadata, arrays) form.
+
+The personalization plane's vocabulary (ISSUE 13): a cohort's adapter is a
+flat list of rank-``r`` A/B factors for the targeted per-layer dense
+modules, named after the base parameters they adapt —
+
+    base   blocks/block/wqkv/kernel      [L, d_in, d_out]
+    lora   blocks/block/wqkv_lora_a      [L, d_in, r]
+           blocks/block/wqkv_lora_b      [L, r, d_out]
+
+— exactly the names ``models/mpt.py`` creates when ``model.lora_rank > 0``
+(training) and the names ``models/decode.py`` consumes functionally at
+serve time (the base checkpoint stays adapter-free; adapters ride beside
+it). The adapted projection is
+
+    y = h @ W  +  (h @ A) @ B · alpha/r
+
+with A fresh-initialized N(0, σ) and B zero, so a new adapter is exactly
+the identity. Everything here operates on host numpy in the codec's
+canonical sorted-name order, so adapters compose with every transport /
+checkpoint / aggregation path the base payloads already ride.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+
+import numpy as np
+
+from photon_tpu.codec import ParamsMetadata
+
+LORA_A_SUFFIX = "_lora_a"
+LORA_B_SUFFIX = "_lora_b"
+LORA_MARK = "_lora_"
+
+#: optimizer freeze pattern for adapter training: every param whose path
+#: does NOT contain the lora mark is frozen (optax ``set_to_zero`` via
+#: ``OptimizerConfig.freeze_patterns`` — base params get exactly-zero
+#: updates, never touch the optimizer state, and never move on the wire)
+BASE_FREEZE_PATTERN = r"^(?!.*_lora_)"
+
+
+def is_adapter_name(name: str) -> bool:
+    return LORA_MARK in name
+
+
+@dataclasses.dataclass(frozen=True)
+class AdapterSpec:
+    """Shape contract of one model's adapters: which modules are adapted
+    and the A/B factor shapes, derived from the BASE parameter metadata
+    (so MHA's fused ``wqkv`` vs GQA's split ``q/k/v_proj`` resolve from
+    the actual model family, not from the target list alone)."""
+
+    rank: int
+    alpha: float
+    #: (name stem ``blocks/block/{module}``, A shape, B shape), sorted by stem
+    entries: tuple[tuple[str, tuple[int, ...], tuple[int, ...]], ...]
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+    @property
+    def n_params(self) -> int:
+        return sum(
+            int(np.prod(a, dtype=np.int64)) + int(np.prod(b, dtype=np.int64))
+            for _, a, b in self.entries
+        )
+
+    def modules(self) -> list[str]:
+        """Module names (``wqkv``, ``out_proj``, ...) in entry order."""
+        return [stem.rsplit("/", 1)[-1] for stem, _, _ in self.entries]
+
+
+def spec_from_base(meta: ParamsMetadata, rank: int, alpha: float,
+                   targets: tuple[str, ...] | list[str]) -> AdapterSpec:
+    """Derive the adapter shape contract from a BASE payload's metadata:
+    every scan-stacked block kernel ``blocks/.../{module}/kernel`` whose
+    module is targeted grows an ``[L, d_in, r]`` A and ``[L, r, d_out]``
+    B. Raises if no target matches (a silently empty adapter plane would
+    train nothing)."""
+    if rank < 1:
+        raise ValueError(f"need rank >= 1, got {rank}")
+    targets = set(targets)
+    entries = []
+    for name, shape in zip(meta.names, meta.shapes):
+        if not name.endswith("/kernel") or len(shape) != 3:
+            continue
+        stem = name[: -len("/kernel")]
+        module = stem.rsplit("/", 1)[-1]
+        if module not in targets:
+            continue
+        n_layers, d_in, d_out = shape
+        entries.append((stem, (n_layers, d_in, rank), (n_layers, rank, d_out)))
+    if not entries:
+        raise ValueError(
+            f"no base parameter matches adapter targets {sorted(targets)} — "
+            "is the model family missing these modules?"
+        )
+    return AdapterSpec(rank=rank, alpha=float(alpha),
+                       entries=tuple(sorted(entries)))
+
+
+def spec_from_params(params, rank: int, alpha: float,
+                     targets: tuple[str, ...] | list[str]) -> AdapterSpec:
+    """:func:`spec_from_base` from a live (base) parameter pytree — shapes
+    only, no host copy (the serving engine derives its pool shapes here)."""
+    from photon_tpu.codec import flatten_params
+
+    names, leaves = flatten_params(params)
+    meta = ParamsMetadata(
+        names=tuple(names),
+        shapes=tuple(tuple(int(d) for d in np.shape(l)) for l in leaves),
+        dtypes=tuple("float32" for _ in names),
+    )
+    return spec_from_base(meta, rank, alpha, targets)
+
+
+def adapter_metadata(spec: AdapterSpec) -> ParamsMetadata:
+    """The adapter payload's metadata in CANONICAL (sorted-name) order —
+    the same order ``codec.flatten_params`` yields for the training
+    model's lora params, so wire/checkpoint/aggregation indices line up
+    without a mapping table."""
+    named = []
+    for stem, a_shape, b_shape in spec.entries:
+        named.append((stem + LORA_A_SUFFIX, a_shape))
+        named.append((stem + LORA_B_SUFFIX, b_shape))
+    named.sort()
+    return ParamsMetadata(
+        names=tuple(n for n, _ in named),
+        shapes=tuple(tuple(s) for _, s in named),
+        dtypes=tuple("float32" for _ in named),
+    )
+
+
+def cohort_seed(base_seed: int, cohort: str) -> int:
+    """Deterministic per-cohort init seed, stable across processes (Python
+    ``hash`` is salted per process)."""
+    return (int(base_seed) * 1000003 + zlib.crc32(cohort.encode())) & 0x7FFFFFFF
+
+
+def init_adapter_arrays(spec: AdapterSpec, seed: int,
+                        std: float = 0.02) -> tuple[ParamsMetadata, list[np.ndarray]]:
+    """Fresh cohort adapter: A ~ N(0, std), B = 0 — delta exactly zero, so
+    round 0 of every cohort serves/trains the bare base."""
+    meta = adapter_metadata(spec)
+    rng = np.random.default_rng(seed)
+    arrays = []
+    for name, shape in zip(meta.names, meta.shapes):
+        if name.endswith(LORA_B_SUFFIX):
+            arrays.append(np.zeros(shape, np.float32))
+        else:
+            arrays.append(rng.normal(0.0, std, shape).astype(np.float32))
+    return meta, arrays
+
+
+def split_adapter(meta: ParamsMetadata, arrays: list[np.ndarray]
+                  ) -> tuple[ParamsMetadata, list[np.ndarray],
+                             ParamsMetadata, list[np.ndarray]]:
+    """Full training payload → (base, adapter) halves, each in canonical
+    order (a subsequence of a sorted list is sorted)."""
+    base_n, base_a, ad_n, ad_a = [], [], [], []
+    for name, arr in zip(meta.names, arrays):
+        if is_adapter_name(name):
+            ad_n.append(name)
+            ad_a.append(arr)
+        else:
+            base_n.append(name)
+            base_a.append(arr)
+    return (ParamsMetadata.from_ndarrays(base_n, base_a), base_a,
+            ParamsMetadata.from_ndarrays(ad_n, ad_a), ad_a)
+
+
+def merge_payload(base_meta: ParamsMetadata, base_arrays: list[np.ndarray],
+                  ameta: ParamsMetadata, aarrays: list[np.ndarray]
+                  ) -> tuple[ParamsMetadata, list[np.ndarray]]:
+    """(base, adapter) halves → one canonical payload (the per-cohort
+    broadcast the training model's ``set_parameters`` consumes). Sorted
+    merge — the combined order must equal ``flatten_params`` of the
+    lora-enabled model's tree."""
+    named = sorted(
+        list(zip(base_meta.names, base_arrays)) + list(zip(ameta.names, aarrays))
+    )
+    names = [n for n, _ in named]
+    arrays = [a for _, a in named]
+    return ParamsMetadata.from_ndarrays(names, arrays), arrays
+
+
+def merge_adapter_into_base(base_meta: ParamsMetadata,
+                            base_arrays: list[np.ndarray],
+                            spec: AdapterSpec,
+                            aarrays: list[np.ndarray]) -> list[np.ndarray]:
+    """Materialize ``W + (alpha/r)·A@B`` into fresh base arrays (fp32 host
+    math) — the export path, and the tests' merged-weights reference."""
+    ameta = adapter_metadata(spec)
+    if len(aarrays) != ameta.n_arrays:
+        raise ValueError(
+            f"adapter payload has {len(aarrays)} arrays, spec expects "
+            f"{ameta.n_arrays}"
+        )
+    a_by_name = dict(zip(ameta.names, aarrays))
+    base_idx = {n: i for i, n in enumerate(base_meta.names)}
+    out = [np.array(a, np.float32, copy=True) for a in base_arrays]
+    for stem, _, _ in spec.entries:
+        ki = base_idx[stem + "/kernel"]
+        a = np.asarray(a_by_name[stem + LORA_A_SUFFIX], np.float32)
+        b = np.asarray(a_by_name[stem + LORA_B_SUFFIX], np.float32)
+        out[ki] = out[ki] + spec.scale * np.einsum("lir,lro->lio", a, b)
+    return out
+
+
+def adapter_tree(spec: AdapterSpec, aarrays: list) -> dict:
+    """Flat adapter arrays → the decode-side pytree
+    ``{module: {"a": [L, d_in, r], "b": [L, r, d_out]}}`` consumed by
+    ``models/decode.py`` / ``serve/cache.py`` (leaves are whatever array
+    type the caller passes — host numpy or gathered device arrays)."""
+    ameta = adapter_metadata(spec)
+    by_name = dict(zip(ameta.names, aarrays))
+    tree = {}
+    for stem, _, _ in spec.entries:
+        module = stem.rsplit("/", 1)[-1]
+        tree[module] = {
+            "a": by_name[stem + LORA_A_SUFFIX],
+            "b": by_name[stem + LORA_B_SUFFIX],
+        }
+    return tree
+
+
+def stack_adapter_trees(trees: list[dict]) -> dict:
+    """Per-row adapter trees → one batched tree with leading ``[B]`` axis
+    (the contiguous oracle's shape, mirroring the serve-side pool
+    gather)."""
+    import jax
+
+    return jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                        *trees)

@@ -339,6 +339,17 @@ class ServerCheckpointManager:
         prefix = self._round_prefix(server_round)
         return npz_to_arrays(self.store.get(f"{prefix}/{PARAMS_FILE}"))
 
+    def load_state_npz(
+        self, server_round: int, key: str
+    ) -> tuple[ParamsMetadata, list[np.ndarray]]:
+        """Read ONE ``{key}.npz`` state object from a round — the
+        adapter-bank load path (ISSUE 13): serving consumers fetch the
+        per-cohort adapter objects without touching the pickled control
+        state or any optimizer moments."""
+        self.wait_pending()  # never read a round a writer may still be landing
+        prefix = self._round_prefix(server_round)
+        return npz_to_arrays(self.store.get(f"{prefix}/{key}.npz"))
+
     # -- GC / import -----------------------------------------------------
     def cleanup(self, keep: int, state_keys: tuple[str, ...] = ()) -> list[int]:
         """Delete all but the newest ``keep`` valid rounds; invalid (partial)

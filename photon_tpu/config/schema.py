@@ -56,6 +56,16 @@ class ModelConfig:
     # positions, but the family supports both)
     alibi: bool = False
     tie_embeddings: bool = True
+    # Per-cohort LoRA adapters (ISSUE 13, photon_tpu/adapters): rank-r A/B
+    # factors on the targeted dense projections. 0 = no adapters (the
+    # default graph, byte-identical to pre-adapter builds). These fields
+    # are normally DERIVED from the ``photon.adapters`` block by
+    # ``adapters.configure_adapter_training`` (train side) — the serving
+    # engine keeps them 0 and applies adapters functionally instead
+    # (base params stay adapter-free in checkpoints).
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: tuple = ()  # module names, e.g. ("wqkv", "out_proj")
     # Llama-family knobs (beyond the reference's MPT configs, which
     # llm-foundry exposes as attn_config/ffn_config variants): RoPE
     # positions, RMSNorm, SwiGLU MLP — composable rather than a separate
@@ -430,6 +440,48 @@ class ServeConfig:
     hotswap_statusz_url: str = ""
 
 
+#: dense-projection module names LoRA can target (the per-layer matmuls
+#: ``models/decode.py`` and ``models/mpt.py`` share; MoE expert weights are
+#: deliberately absent — batch-global capacity routing breaks the per-slot
+#: purity argument the serving gather relies on)
+LORA_TARGETABLE = (
+    "wqkv", "q_proj", "k_proj", "v_proj", "out_proj",
+    "up_proj", "down_proj", "gate_proj",
+)
+
+
+@dataclass
+class AdaptersConfig:
+    """Federated per-cohort LoRA personalization plane (ISSUE 13,
+    ``photon_tpu/adapters``).
+
+    OFF by default (the chaos/telemetry/serve opt-in discipline). Enabled
+    on a TRAINING config, ``federation/collective_round.py`` freezes the
+    federated base, trains rank-``rank`` A/B adapters per client, and
+    aggregates them PER COHORT — all cohorts' reductions fused into one
+    jitted program on the PR 7 plane. Enabled on a SERVING config, the
+    engine grows a second paged adapter pool beside the KV pool and mixed
+    batches gather each slot's cohort adapter per decode step; ``cohort``
+    rides ``/generate``.
+
+    ``cohorts`` maps cohort name → list of client ids (train side; the
+    serve side uses the names only). Cids must not overlap across cohorts;
+    a cid in no cohort trains/serves the bare base model.
+    """
+
+    enabled: bool = False
+    rank: int = 8  # LoRA rank r (> 0 when enabled)
+    alpha: float = 16.0  # delta scale = alpha / rank
+    # targeted dense modules (subset of LORA_TARGETABLE)
+    targets: list = field(default_factory=lambda: [
+        "wqkv", "q_proj", "k_proj", "v_proj", "out_proj",
+    ])
+    cohorts: dict = field(default_factory=dict)  # name -> [cid, ...]
+    # serve-side: resident adapter pages (cohorts decodable without a host
+    # reload; LRU beyond it — same refcount machinery as the KV pool)
+    pool_size: int = 4
+
+
 @dataclass
 class MembershipConfig:
     """Elastic node membership (``federation/membership.py``).
@@ -523,6 +575,7 @@ class PhotonConfig:
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    adapters: AdaptersConfig = field(default_factory=AdaptersConfig)
     save_path: str = "/tmp/photon_tpu"
 
 
@@ -761,6 +814,106 @@ class Config:
             raise ValueError(
                 f"serve.hotswap_poll_s must be > 0, got {srv.hotswap_poll_s}"
             )
+        ad = self.photon.adapters
+        if ad.enabled:
+            if ad.rank < 1:
+                raise ValueError(
+                    f"photon.adapters.rank must be >= 1 when enabled, got "
+                    f"{ad.rank} (rank 0 is no adapter at all)"
+                )
+            if ad.alpha <= 0:
+                raise ValueError(
+                    f"photon.adapters.alpha must be > 0, got {ad.alpha} "
+                    "(the LoRA delta scales by alpha/rank)"
+                )
+            if not ad.targets:
+                raise ValueError(
+                    "photon.adapters.targets is empty — name at least one "
+                    f"dense module to adapt (choose from {LORA_TARGETABLE})"
+                )
+            bad = [t for t in ad.targets if t not in LORA_TARGETABLE]
+            if bad:
+                raise ValueError(
+                    f"photon.adapters.targets {bad} are not adaptable dense "
+                    f"modules (choose from {LORA_TARGETABLE})"
+                )
+            if self.model.mlp == "moe":
+                # same purity argument that makes MoE prefix-ineligible
+                # (PR 10): expert-capacity routing is batch-global, so a
+                # slot's adapted logits would depend on its batch-mates —
+                # the per-cohort serving gather cannot be correct there
+                raise ValueError(
+                    "photon.adapters with model.mlp='moe' is not supported: "
+                    "batch-global expert capacity breaks per-slot adapter "
+                    "purity (the same reason MoE is prefix-cache-ineligible)"
+                )
+            if ad.pool_size < 1:
+                raise ValueError(
+                    f"photon.adapters.pool_size must be >= 1, got "
+                    f"{ad.pool_size}"
+                )
+            if not isinstance(ad.cohorts, dict):
+                raise ValueError(
+                    f"photon.adapters.cohorts must map cohort name -> [cid, "
+                    f"...], got {type(ad.cohorts).__name__}"
+                )
+            if not ad.cohorts:
+                raise ValueError(
+                    "photon.adapters.enabled needs a non-empty cohorts map "
+                    "(cohort name -> [cid, ...]; serve-side configs may use "
+                    "empty cid lists — the names select the adapter bank)"
+                )
+            seen_cids: dict[int, str] = {}
+            for name, cids in ad.cohorts.items():
+                if not isinstance(cids, (list, tuple)):
+                    raise ValueError(
+                        f"photon.adapters.cohorts[{name!r}] must be a list "
+                        f"of client ids, got {type(cids).__name__}"
+                    )
+                for cid in cids:
+                    if not isinstance(cid, int) or cid < 0:
+                        raise ValueError(
+                            f"photon.adapters.cohorts[{name!r}] has a bad "
+                            f"client id {cid!r} (need ints >= 0)"
+                        )
+                    if cid in seen_cids:
+                        raise ValueError(
+                            f"client id {cid} appears in cohorts "
+                            f"{seen_cids[cid]!r} AND {name!r} — cohorts must "
+                            "not overlap (one adapter per client)"
+                        )
+                    seen_cids[cid] = name
+            if self.fl.aggregate_momenta:
+                raise ValueError(
+                    "photon.adapters with fl.aggregate_momenta is not "
+                    "supported: the adapter wire carries A/B factors only "
+                    "(momenta piggybacking is a full-payload feature)"
+                )
+            if self.photon.comm_stack.collective_device_optimizer:
+                raise ValueError(
+                    "photon.adapters runs the per-cohort server optimizers "
+                    "on host (adapter payloads are tiny); set "
+                    "comm_stack.collective_device_optimizer=false"
+                )
+        if self.model.lora_rank < 0:
+            raise ValueError(
+                f"model.lora_rank must be >= 0, got {self.model.lora_rank}"
+            )
+        if self.model.lora_rank:
+            if self.model.lora_alpha <= 0:
+                raise ValueError(
+                    f"model.lora_alpha must be > 0, got "
+                    f"{self.model.lora_alpha}"
+                )
+            bad = [t for t in self.model.lora_targets
+                   if t not in LORA_TARGETABLE]
+            if bad:
+                raise ValueError(
+                    f"model.lora_targets {bad} are not adaptable dense "
+                    f"modules (choose from {LORA_TARGETABLE})"
+                )
+            if self.model.mlp == "moe":
+                raise ValueError("model.lora_rank with mlp='moe' is not supported")
         tel = self.photon.telemetry
         if not 0 <= tel.prom_port <= 65535:
             raise ValueError(
@@ -916,7 +1069,9 @@ def _build_dataclass(cls: type, d: dict[str, Any]) -> Any:
         ftype = hints.get(name)
         if ftype is not None and dataclasses.is_dataclass(ftype) and isinstance(value, dict):
             kwargs[name] = _build_dataclass(ftype, value)
-        elif name == "betas" and isinstance(value, (list, tuple)):
+        elif name in ("betas", "lora_targets") and isinstance(value, (list, tuple)):
+            # tuples keep the dataclass hashable (decode_jit_pair keys the
+            # shared compile cache on dataclasses.astuple(ModelConfig))
             kwargs[name] = tuple(value)
         else:
             kwargs[name] = value

@@ -93,6 +93,9 @@ from photon_tpu.federation.client_runtime import ClientRuntime
 from photon_tpu.federation.membership import LIVE, LivenessTracker
 from photon_tpu.federation.messages import FitIns
 from photon_tpu.utils.profiling import (
+    ADAPTER_COHORTS,
+    ADAPTER_COHORTS_DEGRADED,
+    ADAPTER_WIRE_BYTES,
     COLLECTIVE_AGG_TIME,
     COLLECTIVE_DEGRADED_ROUNDS,
     COLLECTIVE_EXCHANGE_TIME,
@@ -103,6 +106,7 @@ from photon_tpu.utils.profiling import (
     COLLECTIVE_WIRE_BYTES,
     EVAL_LOSS,
     EVAL_SAMPLES,
+    EVENT_ADAPTER_COHORT_DEGRADED,
     EVENT_COLLECTIVE_DEGRADED,
     EVENT_COLLECTIVE_RECONFIG,
     EVENT_COLLECTIVE_STRAGGLER,
@@ -203,6 +207,15 @@ class CollectiveFedRunner:
         self.stage_timeout_s = float(cs.collective_stage_timeout_s)
         self.quorum = float(cs.collective_quorum)
         self.retry_budget = int(cs.collective_retry_budget)
+        # per-cohort LoRA personalization (ISSUE 13): derive the trainer-
+        # side knobs from photon.adapters BEFORE any Trainer/model is
+        # built (the ClientRuntime below constructs the lora-enabled
+        # model; the optimizer freezes every non-adapter param)
+        self._adapters_enabled = bool(cfg.photon.adapters.enabled)
+        if self._adapters_enabled:
+            from photon_tpu.adapters.federated import configure_adapter_training
+
+            configure_adapter_training(cfg)
         mem = cfg.photon.membership
         #: per-client liveness state machine (pseudo node id ``client{cid}``):
         #: fed by fit outcomes here, and — multi-controller — by whatever
@@ -253,6 +266,18 @@ class CollectiveFedRunner:
             if not has_momenta(self.meta):
                 self.meta, initial = extend_with_momenta(self.meta, initial)
         self.strategy.initialize(initial)
+        # adapter mode: split the (base + fresh lora) init payload — the
+        # base is FROZEN for the whole run and broadcast per cohort with
+        # that cohort's adapter; per-cohort server optimizers live on the
+        # AdapterTrainPlane (host — adapter payloads are ~1000x smaller
+        # than the model, so the host update is noise next to the fits)
+        self.adapter_plane = None
+        if self._adapters_enabled:
+            from photon_tpu.adapters.federated import AdapterTrainPlane
+            from photon_tpu.adapters.lora import split_adapter
+
+            base_meta, base_arrays, _, _ = split_adapter(self.meta, initial)
+            self.adapter_plane = AdapterTrainPlane(cfg, base_meta, base_arrays)
         # second-moment rows must leave the server >= 0 (clients sqrt them):
         # true at fp32, but q8 rounding noise turns the exactly-zero
         # pseudo-gradient of idle m2 elements tiny-nonzero and the adaptive
@@ -469,6 +494,8 @@ class CollectiveFedRunner:
         return out
 
     def run_round(self, server_round: int) -> dict[str, float]:
+        if self.adapter_plane is not None:
+            return self._run_round_adapters(server_round)
         t_round = time.monotonic()
         cfg = self.cfg
 
@@ -931,6 +958,418 @@ class CollectiveFedRunner:
         metrics[COLLECTIVE_WIRE_BYTES] = 0.0
         return metrics
 
+    # -- per-cohort adapter rounds (ISSUE 13) ---------------------------
+    def _cohort_broadcast_ptrs(self, tag: str, server_round: int) -> dict:
+        """One merged (base + cohort adapter) payload per cohort this
+        process serves — the per-cohort 'broadcast'. Keyed by cohort name
+        (None = the identity-adapter payload for cohortless cids)."""
+        plane = self.adapter_plane
+        ptrs: dict = {}
+        for cid in self.process_cids:
+            name = plane.cohort_of.get(cid)
+            if name not in ptrs:
+                meta_c, arrays_c = plane.broadcast_payload(cid)
+                ptrs[name] = self.transport.put(
+                    f"adapter-{tag}-r{server_round}-{name or '__base__'}",
+                    meta_c, arrays_c,
+                )
+        return ptrs
+
+    def _run_round_adapters(self, server_round: int) -> dict[str, float]:
+        """One personalization round: per-cohort broadcast → local adapter
+        fits on the frozen base → ALL cohorts' reductions fused into ONE
+        grouped program on the PR 7 plane → per-cohort server-optimizer
+        updates, under the same elastic ladder as the global rounds."""
+        t_round = time.monotonic()
+        cfg = self.cfg
+        plane = self.adapter_plane
+        ptrs = self._cohort_broadcast_ptrs("bcast", server_round)
+
+        t_fit = time.monotonic()
+        landed: dict[int, tuple[list[np.ndarray], int]] = {}
+        for cid in self.process_cids:
+            ins = FitIns(
+                server_round=server_round,
+                cids=[cid],
+                params=ptrs[plane.cohort_of.get(cid)],
+                local_steps=cfg.fl.local_steps,
+                server_steps_cumulative=self.server_steps_cumulative,
+                client_states=(
+                    {cid: self.client_states[cid]} if cid in self.client_states else {}
+                ),
+                config=dict(cfg.fl.fit_config),
+            )
+            res = self.runtime.fit(ins, cid)
+            nid = self._client_node_id(cid)
+            if res.error:
+                self.liveness.observe_miss(nid)
+                telemetry.emit_event(
+                    EVENT_COLLECTIVE_STRAGGLER, round=server_round, cid=cid,
+                    reason="fit_error", detail=res.error[:200],
+                )
+                warnings.warn(
+                    f"adapter round {server_round}: cid {cid} failed "
+                    f"({res.error.splitlines()[0][:120]}) — dropped from the "
+                    "round's cohort",
+                    stacklevel=2,
+                )
+                continue
+            self.liveness.observe_alive(nid)
+            if res.client_state:
+                self.client_states[res.cid] = res.client_state
+            meta, arrays = self.transport.get(res.params)
+            # ONLY the adapter rows ever reach the exchange: the base is
+            # frozen (exactly-zero optimizer updates) and never moves
+            landed[cid] = (plane.extract_adapter(meta, arrays), res.n_samples)
+            self.transport.free(res.params)
+        for ptr in ptrs.values():
+            self.transport.free(ptr)
+
+        crash_point("pre-exchange", server_round, self.runtime.node_id)
+
+        t_agg = time.monotonic()
+        metrics, path, stragglers, reconfig_s = self._aggregate_elastic_adapters(
+            server_round, landed
+        )
+        if metrics is None:
+            warnings.warn(
+                f"adapter round {server_round}: no client deltas landed — "
+                "round recorded failed, every cohort's adapter unchanged",
+                stacklevel=2,
+            )
+            metrics = {
+                ROUND_FAILED: 1.0,
+                COLLECTIVE_STACK_TIME: 0.0,
+                COLLECTIVE_EXCHANGE_TIME: 0.0,
+                COLLECTIVE_UPDATE_TIME: 0.0,
+                COLLECTIVE_WIRE_BYTES: 0.0,
+                ADAPTER_WIRE_BYTES: 0.0,
+                ADAPTER_COHORTS: 0.0,
+                ADAPTER_COHORTS_DEGRADED: float(plane.n_cohorts),
+            }
+        else:
+            self.server_steps_cumulative += cfg.fl.local_steps
+
+        metrics[COLLECTIVE_STRAGGLERS] = float(stragglers)
+        metrics[COLLECTIVE_DEGRADED_ROUNDS] = (
+            1.0 if path == "host_fallback" else 0.0
+        )
+        metrics[COLLECTIVE_RECONFIG_TIME] = reconfig_s
+        metrics[COLLECTIVE_AGG_TIME] = time.monotonic() - t_agg
+        metrics[FIT_ROUND_TIME] = time.monotonic() - t_fit
+        metrics[STEPS_CUMULATIVE] = float(self.server_steps_cumulative)
+        metrics[ROUND_TIME] = time.monotonic() - t_round
+        self.stragglers_total += stragglers
+        if path == "host_fallback":
+            self.degraded_rounds_total += 1
+        self.aggregation_paths[server_round] = path
+        self._observe_collective_health(server_round, metrics, path, stragglers)
+        self.history.record(server_round, metrics)
+        if self._abandoned_workers:
+            # same forgiveness as the global path: a deadline-abandoned
+            # worker's late compile event must not bill a correct round
+            with absorb_compiles("collective/abandoned"):
+                pass
+            self._abandoned_workers = [
+                t for t in self._abandoned_workers if t.is_alive()
+            ]
+        steady_point("collective/round")
+        return metrics
+
+    def _aggregate_elastic_adapters(
+        self,
+        server_round: int,
+        landed: dict[int, tuple[list[np.ndarray], int]],
+    ) -> tuple[dict[str, float] | None, str, int, float]:
+        """The PR 8 ladder over GROUPED aggregation: fused multi-cohort
+        reduction → (reconfigured) retry → per-cohort host fold. The
+        failure unit stays the client; the DEGRADATION unit is the
+        cohort — a cohort whose members all died skips its update while
+        every other cohort proceeds."""
+        n_total = self.cfg.fl.n_total_clients
+        for cid in sorted(set(landed) - set(self._surviving_cohort(landed))):
+            telemetry.emit_event(
+                EVENT_COLLECTIVE_STRAGGLER, round=server_round, cid=cid,
+                reason="liveness",
+            )
+        attempts = 0
+        reconfig_s = 0.0
+        degraded_reason = None
+        while True:
+            cohort = self._surviving_cohort(landed)
+            if not cohort or not any(cid in landed for cid in cohort):
+                return None, "failed", n_total - len(cohort), reconfig_s
+            if len(cohort) < self.quorum * n_total:
+                degraded_reason = (
+                    f"below quorum: {len(cohort)}/{n_total} surviving < "
+                    f"{self.quorum}"
+                )
+                break
+            if attempts > self.retry_budget:
+                degraded_reason = (
+                    f"retry budget exhausted ({self.retry_budget} reconfig "
+                    "attempts)"
+                )
+                break
+            t0 = time.monotonic()
+            # rollback point: a grouped attempt can fail after SOME cohort
+            # updates applied (update-stage deadline mid-loop) — the retry
+            # must start every cohort from the round's entry state
+            snap = self.adapter_plane.strategies.snapshot()
+            try:
+                if len(cohort) < n_total:
+                    with absorb_compiles("collective/reconfig"):
+                        metrics = self._grouped_attempt(
+                            server_round, cohort, landed
+                        )
+                    path = "collective_reconfigured"
+                else:
+                    metrics = self._grouped_attempt(server_round, cohort, landed)
+                    path = "collective"
+                return metrics, path, n_total - len(cohort), reconfig_s
+            except StageDeadlineError as e:
+                reason, stage = str(e), e.stage
+            except Exception as e:  # noqa: BLE001 — same stance as the
+                # global ladder: torn gangs surface as runtime errors as
+                # often as hangs
+                reason, stage = f"{type(e).__name__}: {e}", "exchange"
+            self.adapter_plane.strategies.restore(snap)
+            attempts += 1
+            reconfig_s += time.monotonic() - t0
+            self.reconfigs_total += 1
+            telemetry.emit_event(
+                EVENT_COLLECTIVE_RECONFIG, round=server_round,
+                attempt=attempts, stage=stage, cohort=len(cohort),
+                reason=reason[:200],
+            )
+            warnings.warn(
+                f"adapter round {server_round}: attempt {attempts} failed "
+                f"at stage {stage!r} ({reason.splitlines()[0][:160]}) — "
+                f"reconfiguring ({self.retry_budget - attempts + 1} retries "
+                "left before host fallback)",
+                stacklevel=2,
+            )
+        telemetry.emit_event(
+            EVENT_COLLECTIVE_DEGRADED, round=server_round,
+            cohort=len(cohort), reason=degraded_reason,
+        )
+        warnings.warn(
+            f"adapter round {server_round}: degrading to the per-cohort "
+            f"host fold over {len(cohort)}/{n_total} clients "
+            f"({degraded_reason})",
+            stacklevel=2,
+        )
+        metrics = self._grouped_host_fallback(server_round, cohort, landed)
+        return metrics, "host_fallback", n_total - len(cohort), reconfig_s
+
+    def _grouped_attempt(
+        self,
+        server_round: int,
+        cohort: tuple[int, ...],
+        landed: dict[int, tuple[list[np.ndarray], int]],
+    ) -> dict[str, float]:
+        """One fused grouped-reduction attempt over ``cohort``: every
+        client's adapter row weighted into its own cohort's slot, ONE
+        collective rendezvous for all K cohorts (not K allreduces), each
+        stage under its deadline; the per-cohort server updates run on the
+        caller thread after the fetch stage returns (the abandoned-worker
+        discipline of the global path)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from photon_tpu.parallel.collective_agg import grouped_weighted_average
+        from photon_tpu.strategy.grouped import cohort_onehot
+
+        plane = self.adapter_plane
+        mesh = self._cohort_mesh(cohort)
+        local_cids = [cid for cid in cohort if cid in landed]
+        rows = [landed[cid][0] for cid in local_cids]
+        ns = [landed[cid][1] for cid in local_cids]
+        onehot_local = cohort_onehot(
+            local_cids, plane.cohort_of, plane.cohort_names
+        )
+
+        with telemetry.span(COLLECTIVE_STACK_TIME):
+            t_stage = time.monotonic()
+
+            def _stack():
+                stacked = self._stack_local(rows, mesh, len(cohort))
+                sharding = NamedSharding(mesh, P(CLIENT_AXIS))
+                ns_global = jax.make_array_from_process_local_data(
+                    sharding, np.asarray(ns, np.int32), (len(cohort),)
+                )
+                oh_global = jax.make_array_from_process_local_data(
+                    sharding, onehot_local,
+                    (len(cohort), plane.n_cohorts),
+                )
+                return stacked, ns_global, oh_global
+
+            stacked, ns_global, oh_global = self._run_stage(
+                "stack", _stack, self._stage_deadline()
+            )
+            stack_s = time.monotonic() - t_stage
+
+        with telemetry.span(COLLECTIVE_EXCHANGE_TIME):
+            t_stage = time.monotonic()
+
+            def _exchange():
+                crash_point("mid-exchange", server_round, self.runtime.node_id)
+                avgs, totals = grouped_weighted_average(
+                    stacked, ns_global, oh_global, mesh,
+                    quantization=self.quantization, block=self.q8_block,
+                )
+                jax.block_until_ready(totals)
+                return avgs, totals
+
+            avgs, totals = self._run_stage(
+                "exchange", _exchange, self._stage_deadline()
+            )
+            exchange_s = time.monotonic() - t_stage
+        crash_point("pre-update", server_round, self.runtime.node_id)
+        with telemetry.span(COLLECTIVE_UPDATE_TIME):
+            t_stage = time.monotonic()
+
+            # worker FETCHES only; the strategy mutation happens on the
+            # caller thread, so an abandoned worker can never apply a
+            # stale round later
+            def _fetch():
+                return ([np.asarray(a) for a in avgs], np.asarray(totals))
+
+            avgs_host, totals_host = self._run_stage(
+                "update", _fetch, self._stage_deadline()
+            )
+            counts: dict[str, int] = {n: 0 for n in plane.cohort_names}
+            for cid in cohort:
+                name = plane.cohort_of.get(cid)
+                if name is not None:
+                    counts[name] += 1
+            folds = {}
+            for name in plane.cohort_names:
+                k = plane.strategies.index_of(name)
+                n_samples = int(round(float(totals_host[k])))
+                if n_samples > 0:
+                    folds[name] = (
+                        [a[k] for a in avgs_host], n_samples,
+                        max(counts[name], 1),
+                    )
+            metrics = self._apply_cohort_updates(server_round, cohort, folds)
+            update_s = time.monotonic() - t_stage
+
+        metrics[COLLECTIVE_STACK_TIME] = stack_s
+        metrics[COLLECTIVE_EXCHANGE_TIME] = exchange_s
+        metrics[COLLECTIVE_UPDATE_TIME] = update_s
+        wire = float(
+            modeled_cross_slice_bytes(
+                plane.adapter_sizes(),
+                len(cohort),
+                replica=mesh_replica(mesh),
+                quantization=self.quantization,
+                block=self.q8_block,
+            )
+        )
+        metrics[COLLECTIVE_WIRE_BYTES] = wire
+        metrics[ADAPTER_WIRE_BYTES] = wire
+        return metrics
+
+    def _apply_cohort_updates(
+        self,
+        server_round: int,
+        cohort: tuple[int, ...],
+        folds: dict[str, tuple[list[np.ndarray], int, int]],
+    ) -> dict[str, float]:
+        """Per-cohort server-optimizer updates from ``{cohort: (avg, Σn,
+        n_clients)}``. A configured cohort ABSENT from ``folds`` had no
+        surviving member: its adapter stays frozen, and the degradation is
+        scoped to exactly that cohort (event + health alert — never the
+        round)."""
+        from photon_tpu.utils.profiling import (
+            EFFECTIVE_LR,
+            N_CLIENTS,
+            N_SAMPLES,
+            PARAM_NORM,
+            PSEUDO_GRAD_NORM,
+        )
+
+        plane = self.adapter_plane
+        updated = 0
+        total_samples = 0.0
+        g2 = p2 = 0.0
+        lr = 0.0
+        for name in plane.cohort_names:
+            fold = folds.get(name)
+            if fold is None:
+                self._note_cohort_degraded(server_round, name)
+                continue
+            avg_c, n_samples, n_clients = fold
+            m = plane.strategies.apply_average(
+                server_round, name, avg_c, n_samples, n_clients
+            )
+            updated += 1
+            total_samples += m.get(N_SAMPLES, float(n_samples))
+            g2 += m.get(PSEUDO_GRAD_NORM, 0.0) ** 2
+            p2 += m.get(PARAM_NORM, 0.0) ** 2
+            lr = m.get(EFFECTIVE_LR, lr)
+        return {
+            N_CLIENTS: float(len(cohort)),
+            N_SAMPLES: total_samples,
+            EFFECTIVE_LR: lr,
+            # aggregate norms across cohorts (per-cohort values would
+            # collide in one KPI dict): the l2 of the CONCATENATED
+            # pseudo-gradients / adapter params
+            PSEUDO_GRAD_NORM: float(np.sqrt(g2)),
+            PARAM_NORM: float(np.sqrt(p2)),
+            ADAPTER_COHORTS: float(updated),
+            ADAPTER_COHORTS_DEGRADED: float(plane.n_cohorts - updated),
+        }
+
+    def _note_cohort_degraded(self, server_round: int, name: str) -> None:
+        telemetry.emit_event(
+            EVENT_ADAPTER_COHORT_DEGRADED, round=server_round, cohort=name,
+            reason="no surviving members",
+        )
+        warnings.warn(
+            f"adapter round {server_round}: cohort {name!r} has no "
+            "surviving members — its adapter is unchanged this round",
+            stacklevel=3,
+        )
+        health = telemetry.health_active()
+        if health is not None:
+            health.note_cohort_degraded(
+                round=server_round, cohort=name,
+                reason="no surviving members",
+            )
+
+    def _grouped_host_fallback(
+        self,
+        server_round: int,
+        cohort: tuple[int, ...],
+        landed: dict[int, tuple[list[np.ndarray], int]],
+    ) -> dict[str, float]:
+        """Degradation floor of the adapter ladder: the per-cohort host
+        streaming fold (``strategy/grouped.grouped_host_fold`` — it IS
+        ``aggregate_inplace`` per cohort, so a degraded personalization
+        round is bit-exact with the host plane fed the same survivors)."""
+        from photon_tpu.strategy.grouped import grouped_host_fold
+
+        plane = self.adapter_plane
+        with telemetry.span(COLLECTIVE_EXCHANGE_TIME, degraded=True):
+            t0 = time.monotonic()
+            folds = grouped_host_fold(
+                {cid: landed[cid] for cid in cohort if cid in landed},
+                plane.cohort_of,
+            )
+            fold_s = time.monotonic() - t0
+        with telemetry.span(COLLECTIVE_UPDATE_TIME, degraded=True):
+            t1 = time.monotonic()
+            metrics = self._apply_cohort_updates(server_round, cohort, folds)
+            update_s = time.monotonic() - t1
+        metrics[COLLECTIVE_STACK_TIME] = 0.0
+        metrics[COLLECTIVE_EXCHANGE_TIME] = fold_s
+        metrics[COLLECTIVE_UPDATE_TIME] = update_s
+        # nothing crossed a slice boundary this round
+        metrics[COLLECTIVE_WIRE_BYTES] = 0.0
+        metrics[ADAPTER_WIRE_BYTES] = 0.0
+        return metrics
+
     # -- checkpoint bridge --------------------------------------------------
     def state_for_checkpoint(self):
         """Strategy state ready to serialize. On the device-optimizer path
@@ -938,8 +1377,70 @@ class CollectiveFedRunner:
         (:meth:`DeviceAggregationPlane.sync_strategy`), so this is exactly
         ``Strategy.state_for_checkpoint`` — same keys, same ``_t`` handling
         — and a checkpoint written here resumes through
-        :meth:`load_server_state` on either path."""
+        :meth:`load_server_state` on either path.
+
+        Adapter mode (ISSUE 13): the dict carries one ``adapter__{cohort}``
+        entry (the cohort's A/B factors) plus ``astate__{cohort}__{key}``
+        entries per server-optimizer state tensor list — all riding the
+        same ``save_round`` npz + manifest-CRC machinery, so torn-round
+        detection, GC and the serving watcher apply unchanged."""
+        if self.adapter_plane is not None:
+            from photon_tpu.adapters.checkpoint import (
+                adapter_key,
+                adapter_state_key,
+            )
+
+            st = self.adapter_plane.strategies
+            adapters = st.adapters_for_checkpoint()
+            opt = st.state_for_checkpoint()
+            out = {}
+            for name in st.names:
+                out[adapter_key(name)] = adapters[name]
+                for skey, tensors in opt[name].items():
+                    out[adapter_state_key(name, skey)] = tensors
+            return out
         return self.strategy.state_for_checkpoint()
+
+    def checkpoint_state_keys(self) -> tuple[str, ...]:
+        """The state-key list round validity/resume checks need (global
+        mode: the strategy's ``state_keys``; adapter mode: every
+        per-cohort adapter + optimizer-state npz)."""
+        if self.adapter_plane is not None:
+            from photon_tpu.adapters.checkpoint import adapter_state_keys
+
+            return adapter_state_keys(
+                self.adapter_plane.cohort_names,
+                self.adapter_plane.strategies.state_keys,
+            )
+        return tuple(self.strategy.state_keys)
+
+    def save_checkpoint(self, mgr, server_round: int) -> None:
+        """Write this round through ``ServerCheckpointManager.save_round``
+        (manifest written last — the serving hot-swap watcher only ever
+        sees completed rounds). Adapter mode saves the FROZEN base as the
+        params object and the per-cohort adapters/optimizer state as
+        state objects; ``load_adapter_bank`` / :meth:`resume_from` are the
+        inverses."""
+        if self.adapter_plane is not None:
+            meta = self.adapter_plane.base_meta
+            params = self.adapter_plane.base_arrays
+        else:
+            meta, params = self.meta, self.strategy.current_parameters
+        mgr.save_round(
+            server_round, meta, params,
+            strategy_state=self.state_for_checkpoint(),
+            server_state={"server_round": server_round,
+                          **self.control_state_for_checkpoint()},
+        )
+
+    def resume_from(self, mgr, resume_round: int = -1) -> int:
+        """Resolve (checksum-verified) + load + re-seed; returns the
+        resumed round number."""
+        keys = self.checkpoint_state_keys()
+        rnd = mgr.resolve_resume_round(resume_round, keys)
+        _, params, state, server_state = mgr.load_round(rnd, keys)
+        self.load_server_state(params, state, server_state)
+        return rnd
 
     def control_state_for_checkpoint(self) -> dict:
         """The non-tensor control snapshot a resume needs alongside the
@@ -950,13 +1451,18 @@ class CollectiveFedRunner:
         | "host_fallback" | "failed") so a resume — and anyone auditing the
         manifest-checksummed checkpoint chain (PR 3) — can tell a degraded
         round's parameters from a full-cohort collective's."""
-        return {
+        out = {
             "server_steps_cumulative": self.server_steps_cumulative,
             "client_states": dict(self.client_states),
             "aggregation_paths": {
                 int(r): p for r, p in self.aggregation_paths.items()
             },
         }
+        if self.adapter_plane is not None:
+            # per-cohort adaptive step counters: bias correction stays
+            # continuous per cohort across a resume
+            out["adapter_t"] = self.adapter_plane.strategies.t_counters()
+        return out
 
     def load_server_state(self, parameters, state=None, control=None) -> None:
         """Resume: re-seed the strategy replica (and, when enabled, the
@@ -964,8 +1470,42 @@ class CollectiveFedRunner:
         adaptive strategies' ``_t`` rides ``state`` exactly as in the
         driver topology, so bias correction stays continuous across the
         restart; ``control`` (:meth:`control_state_for_checkpoint`) restores
-        the step counter and the per-client loader positions."""
-        self.strategy.initialize(parameters, state)
+        the step counter and the per-client loader positions.
+
+        Adapter mode: ``parameters`` is the frozen BASE; ``state`` carries
+        the per-cohort ``adapter__*`` / ``astate__*`` entries written by
+        :meth:`state_for_checkpoint`."""
+        if self.adapter_plane is not None:
+            from photon_tpu.adapters.checkpoint import (
+                adapter_key,
+                adapter_state_key,
+            )
+
+            plane = self.adapter_plane
+            plane.base_arrays = [np.asarray(p, np.float32) for p in parameters]
+            state = state or {}
+            adapters: dict[str, list[np.ndarray]] = {}
+            opt: dict[str, dict[str, list[np.ndarray]]] = {}
+            for name in plane.cohort_names:
+                key = adapter_key(name)
+                if key not in state:
+                    raise ValueError(
+                        f"checkpoint carries no adapter for cohort {name!r} "
+                        f"(key {key!r}) — cohort map changed since the save?"
+                    )
+                adapters[name] = state[key]
+                opt[name] = {
+                    skey: state[adapter_state_key(name, skey)]
+                    for skey in plane.strategies.state_keys
+                    if adapter_state_key(name, skey) in state
+                }
+            t = {
+                str(k): int(v)
+                for k, v in ((control or {}).get("adapter_t", {}) or {}).items()
+            }
+            plane.strategies.initialize(adapters, opt, t=t)
+        else:
+            self.strategy.initialize(parameters, state)
         if control:
             self.server_steps_cumulative = int(
                 control.get("server_steps_cumulative", self.server_steps_cumulative)
@@ -993,15 +1533,24 @@ class CollectiveFedRunner:
         from photon_tpu.federation.messages import EvaluateIns
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        ptr = self.transport.put(
-            f"collective-eval-r{server_round}", self.meta, self.strategy.current_parameters
-        )
-        self.runtime.set_broadcast_params(ptr)
+        eval_ptrs: dict = {}
+        if self.adapter_plane is not None:
+            # personalization: every client scores its OWN cohort's
+            # (base + adapter) params — eval measures the model the
+            # cohort actually gets served
+            eval_ptrs = self._cohort_broadcast_ptrs("eval", server_round)
+        else:
+            ptr = self.transport.put(
+                f"collective-eval-r{server_round}", self.meta, self.strategy.current_parameters
+            )
+            self.runtime.set_broadcast_params(ptr)
         losses: list[np.ndarray] = []
         ns: list[int] = []
         for cid in self.process_cids:
             ins = EvaluateIns(
-                server_round=server_round, cids=[cid], params=None,
+                server_round=server_round, cids=[cid],
+                params=(eval_ptrs[self.adapter_plane.cohort_of.get(cid)]
+                        if self.adapter_plane is not None else None),
                 config=dict(self.cfg.fl.eval_config),
             )
             res = self.runtime.evaluate(ins, cid)
@@ -1028,6 +1577,8 @@ class CollectiveFedRunner:
             self.liveness.observe_alive(nid)
             losses.append(np.asarray([res.loss], np.float32))
             ns.append(res.n_samples)
+        for ptr in eval_ptrs.values():
+            self.transport.free(ptr)
 
         # losses are [1]-vectors — quantizing them would be all cost, no
         # byte savings, so eval always rides the fp32 exchange. The

@@ -76,22 +76,43 @@ def _rope_at(x: jax.Array, pos: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _dense(lp: dict, name: str, h: jax.Array) -> jax.Array:
+def _lora_delta(ad: dict, h: jax.Array, scale: float) -> jax.Array:
+    """Per-ROW LoRA delta ``(h @ A) @ B · scale`` for batched adapters:
+    ``ad["a"]``/``ad["b"]`` carry a leading batch axis aligned with ``h``'s
+    (row b of the batch uses row b's adapter — the serving pool gather and
+    the contiguous mixed-cohort oracle compute the identical einsums, so
+    the table indirection stays bitwise invisible exactly like the KV
+    gather). ``h`` is ``[B, D]`` (decode column) or ``[B, T, D]``
+    (prefill/chunk)."""
+    a = ad["a"].astype(h.dtype)
+    b = ad["b"].astype(h.dtype)
+    if h.ndim == 2:
+        t = jnp.einsum("bd,bdr->br", h, a)
+        return jnp.einsum("br,bro->bo", t, b) * scale
+    t = jnp.einsum("btd,bdr->btr", h, a)
+    return jnp.einsum("btr,bro->bto", t, b) * scale
+
+
+def _dense(lp: dict, name: str, h: jax.Array, la: dict | None = None,
+           ls: float = 1.0) -> jax.Array:
     y = h @ lp[name]["kernel"].astype(h.dtype)
     if "bias" in lp[name]:
         y = y + lp[name]["bias"].astype(h.dtype)
+    if la is not None and name in la:
+        y = y + _lora_delta(la[name], h, ls)
     return y
 
 
-def _qkv(lp: dict, h: jax.Array, cfg: ModelConfig):
+def _qkv(lp: dict, h: jax.Array, cfg: ModelConfig, la: dict | None = None,
+         ls: float = 1.0):
     """Project hidden → (q [..., H, Dh], k/v [..., H_kv, Dh])."""
     n_kv = cfg.n_kv_heads or cfg.n_heads
     if "wqkv" in lp:
-        q, k, v = jnp.split(_dense(lp, "wqkv", h), 3, axis=-1)
+        q, k, v = jnp.split(_dense(lp, "wqkv", h, la, ls), 3, axis=-1)
     else:
-        q = _dense(lp, "q_proj", h)
-        k = _dense(lp, "k_proj", h)
-        v = _dense(lp, "v_proj", h)
+        q = _dense(lp, "q_proj", h, la, ls)
+        k = _dense(lp, "k_proj", h, la, ls)
+        v = _dense(lp, "v_proj", h, la, ls)
     lead = h.shape[:-1]
     return (q.reshape(*lead, cfg.n_heads, cfg.d_head),
             k.reshape(*lead, n_kv, cfg.d_head),
@@ -99,13 +120,15 @@ def _qkv(lp: dict, h: jax.Array, cfg: ModelConfig):
 
 
 def _mlp(lp: dict, x: jax.Array, cfg: ModelConfig,
-         token_mask: jax.Array | None = None) -> jax.Array:
+         token_mask: jax.Array | None = None, la: dict | None = None,
+         ls: float = 1.0) -> jax.Array:
     h = _norm(x, lp["ln_2"]["scale"], lp["ln_2"].get("bias"), cfg.norm, cfg.norm_eps)
     if cfg.mlp == "moe":
         # same routing as training (ops/moe.py); aux loss discarded.
         # token_mask (prefill): right-padding must not claim expert
         # capacity — otherwise a row's logits would depend on how much
-        # padding its batch-mates carry
+        # padding its batch-mates carry. (Adapters never reach here:
+        # config validation rejects adapters with MoE.)
         from photon_tpu.ops.moe import moe_mlp
 
         out, _ = moe_mlp(
@@ -116,10 +139,11 @@ def _mlp(lp: dict, x: jax.Array, cfg: ModelConfig,
         )
         return x + out
     if cfg.mlp == "swiglu":
-        h = jax.nn.silu(_dense(lp, "gate_proj", h)) * _dense(lp, "up_proj", h)
+        h = (jax.nn.silu(_dense(lp, "gate_proj", h, la, ls))
+             * _dense(lp, "up_proj", h, la, ls))
     else:
-        h = jax.nn.gelu(_dense(lp, "up_proj", h), approximate=True)
-    return x + _dense(lp, "down_proj", h)
+        h = jax.nn.gelu(_dense(lp, "up_proj", h, la, ls), approximate=True)
+    return x + _dense(lp, "down_proj", h, la, ls)
 
 
 def _embed(params: dict, tokens: jax.Array, pos: jax.Array,
@@ -144,20 +168,38 @@ def _logits(params: dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     return logits.astype(jnp.dtype(cfg.logits_dtype))
 
 
+def _layer_adapters(adapters: dict | None):
+    """Batched adapter tree ``{module: {"a": [B, L, ...], "b": ...}}`` →
+    layer-major leaves ``[L, B, ...]`` ready to ride the layer scan's xs
+    (None passes through)."""
+    if adapters is None:
+        return None
+    return jax.tree.map(lambda x: jnp.moveaxis(jnp.asarray(x), 1, 0), adapters)
+
+
 def prefill(params: dict, tokens: jax.Array, lengths: jax.Array,
-            cfg: ModelConfig) -> tuple[jax.Array, DecodeState]:
+            cfg: ModelConfig, adapters: dict | None = None,
+            lora_scale: float = 1.0) -> tuple[jax.Array, DecodeState]:
     """Full pass over right-padded prompts ``[B, S]`` → (next-token logits
-    ``[B, V]`` at each row's cursor, filled :class:`DecodeState`)."""
+    ``[B, V]`` at each row's cursor, filled :class:`DecodeState`).
+
+    ``adapters`` (optional, ISSUE 13): per-ROW LoRA factors
+    ``{module: {"a": [B, L, d_in, r], "b": [B, L, r, d_out]}}`` — row b
+    runs with row b's adapter (a mixed-cohort batch in one pass), scaled
+    by ``lora_scale``. None keeps the graph byte-identical to the
+    adapter-free build."""
     b, s = tokens.shape
     n_kv = cfg.n_kv_heads or cfg.n_heads
     pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     valid = (pos < lengths[:, None]).astype(jnp.float32)  # [B, S] real tokens
     x = _embed(params, tokens, pos, cfg)
+    ad_l = _layer_adapters(adapters)
 
-    def layer(x, lp):
+    def layer(x, xs):
+        lp, la = xs if adapters is not None else (xs, None)
         h = _norm(x, lp["ln_1"]["scale"], lp["ln_1"].get("bias"),
                   cfg.norm, cfg.norm_eps)
-        q, k, v = _qkv(lp, h, cfg)
+        q, k, v = _qkv(lp, h, cfg, la, lora_scale)
         if cfg.rope:
             q = _rope_at(q, pos, cfg.rope_theta)
             k = _rope_at(k, pos, cfg.rope_theta)
@@ -171,10 +213,13 @@ def prefill(params: dict, tokens: jax.Array, lengths: jax.Array,
             causal=True, alibi=cfg.alibi,
             block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
         )
-        x = x + _dense(lp, "out_proj", attn.reshape(b, s, cfg.d_model))
-        return _mlp(lp, x, cfg, token_mask=valid), (k, v)
+        x = x + _dense(lp, "out_proj", attn.reshape(b, s, cfg.d_model),
+                       la, lora_scale)
+        return _mlp(lp, x, cfg, token_mask=valid, la=la, ls=lora_scale), (k, v)
 
-    x, (ck, cv) = jax.lax.scan(layer, x, params["blocks"]["block"])
+    xs = (params["blocks"]["block"], ad_l) if adapters is not None \
+        else params["blocks"]["block"]
+    x, (ck, cv) = jax.lax.scan(layer, x, xs)
     idx = jnp.clip(lengths - 1, 0, s - 1)
     last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
     return _logits(params, last, cfg), DecodeState(
@@ -183,9 +228,11 @@ def prefill(params: dict, tokens: jax.Array, lengths: jax.Array,
 
 
 def decode_step(params: dict, state: DecodeState, token: jax.Array,
-                cfg: ModelConfig) -> tuple[jax.Array, DecodeState]:
+                cfg: ModelConfig, adapters: dict | None = None,
+                lora_scale: float = 1.0) -> tuple[jax.Array, DecodeState]:
     """Place ``token [B]`` at each row's cursor, attend into the caches,
-    return (logits for the FOLLOWING position, advanced state)."""
+    return (logits for the FOLLOWING position, advanced state).
+    ``adapters``: per-row LoRA factors as in :func:`prefill`."""
     n_kv = cfg.n_kv_heads or cfg.n_heads
     group = cfg.n_heads // n_kv
     s = state.cache_k.shape[2]
@@ -195,12 +242,16 @@ def decode_step(params: dict, state: DecodeState, token: jax.Array,
     k_pos = jnp.arange(s)[None, :]  # [1, S]
     valid = (k_pos <= pos[:, None])  # j <= pos, per row
     oh = jax.nn.one_hot(pos, s, dtype=state.cache_k.dtype)[:, :, None, None]
+    ad_l = _layer_adapters(adapters)
 
     def layer(x, xs):
-        lp, ck, cv = xs  # ck/cv: [B, S, H_kv, Dh]
+        if adapters is not None:
+            lp, ck, cv, la = xs
+        else:
+            (lp, ck, cv), la = xs, None  # ck/cv: [B, S, H_kv, Dh]
         h = _norm(x, lp["ln_1"]["scale"], lp["ln_1"].get("bias"),
                   cfg.norm, cfg.norm_eps)
-        q, k_new, v_new = _qkv(lp, h, cfg)  # q [B,H,Dh], k/v [B,Hkv,Dh]
+        q, k_new, v_new = _qkv(lp, h, cfg, la, lora_scale)  # q [B,H,Dh]
         if cfg.rope:
             q = _rope_at(q[:, None], pos[:, None], cfg.rope_theta)[:, 0]
             k_new = _rope_at(k_new[:, None], pos[:, None], cfg.rope_theta)[:, 0]
@@ -217,12 +268,14 @@ def decode_step(params: dict, state: DecodeState, token: jax.Array,
         scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
         probs = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(cv.dtype), cv)
-        x = x + _dense(lp, "out_proj", out.reshape(x.shape[0], cfg.d_model))
-        return _mlp(lp, x, cfg), (ck, cv)
+        x = x + _dense(lp, "out_proj", out.reshape(x.shape[0], cfg.d_model),
+                       la, lora_scale)
+        return _mlp(lp, x, cfg, la=la, ls=lora_scale), (ck, cv)
 
-    x, (ck, cv) = jax.lax.scan(
-        layer, x, (params["blocks"]["block"], state.cache_k, state.cache_v)
-    )
+    xs = (params["blocks"]["block"], state.cache_k, state.cache_v)
+    if adapters is not None:
+        xs = xs + (ad_l,)
+    x, (ck, cv) = jax.lax.scan(layer, x, xs)
     return _logits(params, x, cfg), DecodeState(
         cache_k=ck, cache_v=cv, lengths=state.lengths + 1
     )

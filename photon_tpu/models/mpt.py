@@ -145,6 +145,31 @@ class MPTBlock(nn.Module):
             kernel_init=nn.initializers.normal(stddev=init_std),
             name=name,
         )
+
+        def adapted(feats: int, name: str, init_std: float, h: jax.Array):
+            """Targeted dense projection + optional LoRA delta (ISSUE 13):
+            ``y + (h @ A) @ B · alpha/r`` when ``name`` is an adapted
+            module. ``lora_rank == 0`` leaves the graph byte-identical to
+            the pre-adapter build. A starts N(0, emb_init_std), B at zero,
+            so a fresh adapter is exactly the identity; the flat param
+            names (``blocks/block/{name}_lora_a``) are the wire/checkpoint
+            vocabulary ``adapters/lora.py`` builds against."""
+            y = dense(feats, name, init_std)(h)
+            if cfg.lora_rank and name in cfg.lora_targets:
+                pd = _dtype(cfg.param_dtype)
+                a = self.param(
+                    f"{name}_lora_a",
+                    nn.initializers.normal(stddev=cfg.emb_init_std),
+                    (h.shape[-1], cfg.lora_rank), pd,
+                )
+                bm = self.param(
+                    f"{name}_lora_b", nn.initializers.zeros,
+                    (cfg.lora_rank, feats), pd,
+                )
+                scale = cfg.lora_alpha / cfg.lora_rank
+                y = y + ((h @ a.astype(h.dtype)) @ bm.astype(h.dtype)) * scale
+            return y
+
         resid_std = cfg.emb_init_std / (2.0 * cfg.n_layers) ** 0.5
 
         # --- attention ---
@@ -152,16 +177,16 @@ class MPTBlock(nn.Module):
         n_kv = cfg.n_kv_heads or cfg.n_heads
         b, s, _ = h.shape
         if n_kv == cfg.n_heads:
-            qkv = dense(3 * cfg.d_model, "wqkv", cfg.emb_init_std)(h)
+            qkv = adapted(3 * cfg.d_model, "wqkv", cfg.emb_init_std, h)
             q, k, v = jnp.split(qkv, 3, axis=-1)
         else:
             # GQA: separate projections — a fused q||k||v matrix would put
             # shard boundaries at positions that don't align with the
             # tensor axis and force per-layer resharding; three
             # column-parallel matmuls stay shard-local
-            q = dense(cfg.n_heads * cfg.d_head, "q_proj", cfg.emb_init_std)(h)
-            k = dense(n_kv * cfg.d_head, "k_proj", cfg.emb_init_std)(h)
-            v = dense(n_kv * cfg.d_head, "v_proj", cfg.emb_init_std)(h)
+            q = adapted(cfg.n_heads * cfg.d_head, "q_proj", cfg.emb_init_std, h)
+            k = adapted(n_kv * cfg.d_head, "k_proj", cfg.emb_init_std, h)
+            v = adapted(n_kv * cfg.d_head, "v_proj", cfg.emb_init_std, h)
         q = q.reshape(b, s, cfg.n_heads, cfg.d_head)
         k = k.reshape(b, s, n_kv, cfg.d_head)
         v = v.reshape(b, s, n_kv, cfg.d_head)
@@ -180,7 +205,7 @@ class MPTBlock(nn.Module):
             interpret=cfg.attn_interpret,
         )
         attn_out = attn_out.reshape(b, s, cfg.d_model)
-        x = x + dense(cfg.d_model, "out_proj", resid_std)(attn_out)
+        x = x + adapted(cfg.d_model, "out_proj", resid_std, attn_out)
 
         # --- MLP ---
         h = _norm(cfg, "ln_2")(x)
@@ -232,13 +257,13 @@ class MPTBlock(nn.Module):
             # is shard-local — a fused gate||up matrix would put ALL of gate
             # on the first half of the tensor group and force a per-layer
             # resharding collective
-            gate = dense(hidden, "gate_proj", cfg.emb_init_std)(h)
-            up = dense(hidden, "up_proj", cfg.emb_init_std)(h)
+            gate = adapted(hidden, "gate_proj", cfg.emb_init_std, h)
+            up = adapted(hidden, "up_proj", cfg.emb_init_std, h)
             h = nn.silu(gate) * up
         else:
-            h = dense(hidden, "up_proj", cfg.emb_init_std)(h)
+            h = adapted(hidden, "up_proj", cfg.emb_init_std, h)
             h = nn.gelu(h, approximate=True)
-        x = x + dense(cfg.d_model, "down_proj", resid_std)(h)
+        x = x + adapted(cfg.d_model, "down_proj", resid_std, h)
         return x
 
 

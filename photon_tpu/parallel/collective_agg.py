@@ -149,11 +149,12 @@ def _check_one_row(ns_shape: tuple) -> None:
         )
 
 
-def _build_average_local(
-    mesh: Mesh, quantization: str, block: int
-) -> Callable:
-    """The per-device body of the (hierarchical, optionally quantized)
-    weighted average. Closure constants only — no traced branches."""
+def _make_reduce_leaf(mesh: Mesh, quantization: str, block: int) -> Callable:
+    """Shared cross-client reduction body (flat psum / hierarchical
+    two-stage / q8 DCN leg) — the single construction point for the plain
+    weighted average AND the grouped per-cohort average (ISSUE 13), so the
+    grouped program inherits the exact wire semantics (and error bounds)
+    the PR 7 plane pinned."""
     n_clients = int(mesh.shape[CLIENT_AXIS])
     replica = mesh_replica(mesh)
     has_replica = REPLICA_AXIS in mesh.axis_names
@@ -203,6 +204,16 @@ def _build_average_local(
             # ICI all-gather reassembles the full replicated vector
             red = jax.lax.all_gather(red, REPLICA_AXIS, tiled=True)
         return red[:n].reshape(shape)
+
+    return _reduce_leaf
+
+
+def _build_average_local(
+    mesh: Mesh, quantization: str, block: int
+) -> Callable:
+    """The per-device body of the (hierarchical, optionally quantized)
+    weighted average. Closure constants only — no traced branches."""
+    _reduce_leaf = _make_reduce_leaf(mesh, quantization, block)
 
     def local(ns, *leaves):
         # ns: [1] local sample count; leaves: [1, ...] rows (see
@@ -257,6 +268,106 @@ def evict_mesh_programs(mesh: Mesh) -> None:
     process lifetime otherwise."""
     for key in [k for k in _AVG_PROGRAMS if k[0] is mesh]:
         del _AVG_PROGRAMS[key]
+    for key in [k for k in _GROUPED_PROGRAMS if k[0] is mesh]:
+        del _GROUPED_PROGRAMS[key]
+
+
+# ---------------------------------------------------------------------------
+# grouped (per-cohort) weighted average — ISSUE 13
+# ---------------------------------------------------------------------------
+
+
+def _build_grouped_local(
+    mesh: Mesh, n_cohorts: int, quantization: str, block: int
+) -> Callable:
+    """Per-device body of the fused multi-cohort reduction: every client
+    contributes its row weighted into its OWN cohort's slot of a
+    ``[n_cohorts, ...]`` stack, and ONE cross-client reduction (the same
+    hierarchical / optionally-q8 body as the plain average) lands every
+    cohort's sample-weighted mean in a single program — K cohorts cost one
+    collective rendezvous, not K. Adapter payloads are tiny, so the K-fold
+    stack stays far below one full-model exchange."""
+    _reduce_leaf = _make_reduce_leaf(mesh, quantization, block)
+
+    def local(ns, onehot, *leaves):
+        # ns: [1] local sample count; onehot: [1, K] this client's cohort
+        # row; leaves: [1, ...] rows — all sharded on the client axis.
+        _check_one_row(ns.shape)
+        n = ns[0].astype(jnp.float32)
+        # per-cohort Σn rides the same program (one psum): cohorts with no
+        # surviving member total 0 — their slot averages to exactly 0 and
+        # the CALLER must skip them (max() only guards the division)
+        totals = jax.lax.psum(n * onehot[0], CLIENT_AXIS)  # [K]
+        w = onehot[0] * (n / jnp.maximum(totals, 1.0))  # [K] cohort weights
+        outs = []
+        for leaf in leaves:
+            row = leaf[0].astype(jnp.float32)
+            contrib = w.reshape((n_cohorts,) + (1,) * row.ndim) * row[None]
+            outs.append(_reduce_leaf(contrib))
+        return tuple(outs) + (totals,)
+
+    return local
+
+
+#: (mesh, n_leaves, n_cohorts, quantization, block) → jitted grouped
+#: program; same build-once discipline as _AVG_PROGRAMS (a fresh shard_map
+#: per round would retrace, which the sentinel e2e forbids)
+_GROUPED_PROGRAMS: dict[tuple, Callable] = {}
+
+
+def _grouped_program(
+    mesh: Mesh, n_leaves: int, n_cohorts: int, quantization: str, block: int
+) -> Callable:
+    key = (mesh, n_leaves, n_cohorts, quantization, block)
+    prog = _GROUPED_PROGRAMS.get(key)
+    if prog is None:
+        local = _build_grouped_local(mesh, n_cohorts, quantization, block)
+        mapped = _full_shard_map(
+            local,
+            mesh,
+            in_specs=(P(CLIENT_AXIS), P(CLIENT_AXIS))
+            + tuple(P(CLIENT_AXIS) for _ in range(n_leaves)),
+            out_specs=tuple(P() for _ in range(n_leaves)) + (P(),),
+        )
+        prog = _GROUPED_PROGRAMS[key] = jax.jit(mapped)
+    return prog
+
+
+def grouped_weighted_average(
+    stacked_flat: Sequence[jax.Array],
+    n_samples: jax.Array,
+    cohort_onehot: jax.Array,
+    mesh: Mesh,
+    quantization: str = "off",
+    block: int = DEFAULT_BLOCK,
+) -> tuple[list[jax.Array], jax.Array]:
+    """Sample-weighted PER-COHORT averages over the client axis in ONE
+    fused program (ISSUE 13: all cohorts' reductions batched into a single
+    rendezvous on the PR 7 plane).
+
+    ``stacked_flat``: flat leaves ``[n_clients, ...]`` sharded on the
+    client axis (each client's adapter row). ``n_samples``:
+    ``[n_clients] int``. ``cohort_onehot``: ``[n_clients, n_cohorts]``
+    0/1 assignment (a client in no cohort is an all-zero row and
+    contributes nowhere). Returns ``([K, ...] fp32 averaged leaves,
+    replicated, and the per-cohort Σn [K])`` — a cohort whose total is 0
+    had no surviving member this round; its average slot is meaningless
+    zeros and callers must leave that cohort's state untouched."""
+    if quantization not in COLLECTIVE_QUANTIZATIONS:
+        raise ValueError(
+            f"quantization must be one of {COLLECTIVE_QUANTIZATIONS}, got "
+            f"{quantization!r}"
+        )
+    if block < 1:
+        raise ValueError(f"block must be >= 1, got {block}")
+    n_cohorts = int(cohort_onehot.shape[1])
+    if n_cohorts < 1:
+        raise ValueError("need at least one cohort column")
+    prog = _grouped_program(
+        mesh, len(stacked_flat), n_cohorts, quantization, block
+    )
+    out = prog(n_samples, cohort_onehot, *stacked_flat)
+    return list(out[:-1]), out[-1]
 
 
 def hierarchical_weighted_average(

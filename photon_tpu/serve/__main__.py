@@ -122,6 +122,9 @@ def main(argv: list[str] | None = None) -> None:
         "block_size": engine.block_size,
         "prefix_cache": engine.prefix_cache is not None,
         "hotswap": watcher is not None,
+        # per-cohort LoRA plane (ISSUE 13): cohorts this daemon can decode
+        "adapters": (engine.adapter_pool.cohorts()
+                     if engine.adapter_pool is not None else None),
     }), flush=True)
 
     # SIGTERM = graceful drain (ISSUE 8 satellite): healthz flips to

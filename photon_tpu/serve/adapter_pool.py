@@ -1,0 +1,198 @@
+"""Paged per-cohort adapter pool — the KV pool's little sibling (ISSUE 13).
+
+One fixed device-resident stack per adapter leaf, ``[pool_size + 1, L,
+...]``, where the LAST page is the trash page: all-zero factors, i.e. the
+identity adapter — a slot with no cohort reads it and decodes the bare
+base model through the exact same gather graph. Pages are managed by the
+same refcounted :class:`~photon_tpu.serve.cache.BlockAllocator` discipline
+as KV blocks:
+
+- the pool's cohort→page index holds ONE reference per resident cohort
+  (the prefix-cache pattern: residency alone pins nothing for good);
+- every serving slot decoding that cohort holds one more
+  (:meth:`acquire` / :meth:`release` at admission / eviction);
+- a cohort is evictable exactly when only the index references it —
+  eviction drops the index reference and the page returns to the free
+  list for the next cohort (recycled pages are fully overwritten by the
+  load, so stale factors can never leak across cohorts).
+
+Page loads are ONE jitted scatter (page id traced, shapes fixed), so a
+cohort miss costs a host→device copy of a few hundred KB — never a
+retrace. The engine's mixed step gathers each slot's page by row id
+(``leaves[page_rows]``), which is fixed-shape too: mixed-cohort batches,
+cohort churn, and bank hot-swaps all leave the compiled step untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.adapters.lora import AdapterSpec, adapter_metadata
+from photon_tpu.serve.cache import BlockAllocator
+
+
+class AdapterPool:
+    """Device-resident cohort adapter pages + host bank.
+
+    Thread discipline mirrors the engine's: ONE driver thread calls
+    acquire/release/install_bank; HTTP handlers only read the scalar
+    stats."""
+
+    def __init__(self, spec: AdapterSpec, pool_size: int) -> None:
+        if pool_size < 1:
+            raise ValueError(f"need pool_size >= 1, got {pool_size}")
+        self.spec = spec
+        self.size = pool_size
+        self.trash_page = pool_size
+        self.allocator = BlockAllocator(pool_size)
+        meta = adapter_metadata(spec)
+        self._names = meta.names
+        self._shapes = meta.shapes
+        self._leaves: list[jax.Array] = [
+            jnp.zeros((pool_size + 1,) + tuple(s), jnp.float32)
+            for s in meta.shapes
+        ]
+        #: host bank: cohort -> flat adapter arrays (canonical order)
+        self._bank: dict[str, list[np.ndarray]] = {}
+        #: resident cohort -> page, in LRU order (oldest first)
+        self._pages: dict[str, int] = {}
+        self.loads = 0
+        self.evictions = 0
+        self.hits = 0
+        self.requests = 0
+        # page id rides traced: one compile covers every page of the pool
+        self._write = jax.jit(
+            lambda leaves, page, vals: tuple(
+                l.at[page].set(v) for l, v in zip(leaves, vals)
+            ),
+            donate_argnums=0,
+        )
+
+    # -- bank -------------------------------------------------------------
+    def install_bank(self, bank: dict[str, Sequence[np.ndarray]]) -> None:
+        """Replace the host bank (a hot-swap installs the new round's
+        adapters here, atomically with the base params: the engine only
+        calls this quiesced, with zero active slots). Every resident page
+        is dropped — factors trained against the OLD base are invalid
+        under the new — so the next admission per cohort reloads."""
+        checked: dict[str, list[np.ndarray]] = {}
+        for cohort, arrays in bank.items():
+            arrays = [np.asarray(a, np.float32) for a in arrays]
+            if len(arrays) != len(self._names):
+                raise ValueError(
+                    f"cohort {cohort!r} adapter has {len(arrays)} arrays, "
+                    f"spec expects {len(self._names)}"
+                )
+            for name, shape, a in zip(self._names, self._shapes, arrays):
+                if tuple(a.shape) != tuple(shape):
+                    raise ValueError(
+                        f"cohort {cohort!r} {name}: shape {tuple(a.shape)} "
+                        f"!= spec {tuple(shape)}"
+                    )
+            checked[cohort] = arrays
+        self.flush()
+        self._bank = checked
+
+    def flush(self) -> None:
+        """Drop every RESIDENT page (the index's references; pages pinned
+        by live slots would leak — callers quiesce first, as with
+        ``engine.set_params``)."""
+        for cohort, page in list(self._pages.items()):
+            self.allocator.free([page])
+        self._pages.clear()
+
+    def has_cohort(self, cohort: str) -> bool:
+        return cohort in self._bank
+
+    def cohorts(self) -> list[str]:
+        return sorted(self._bank)
+
+    # -- admission-side API ----------------------------------------------
+    def can_acquire(self, cohort: str) -> bool:
+        """Admissibility: known cohort AND (already resident, a free page,
+        or an unpinned resident page to evict)."""
+        if cohort not in self._bank:
+            return False
+        if cohort in self._pages or self.allocator.free_blocks > 0:
+            return True
+        return any(
+            self.allocator.refcount(p) == 1 for p in self._pages.values()
+        )
+
+    def acquire(self, cohort: str) -> int:
+        """Pin ``cohort``'s page for one slot (one allocator reference);
+        loads it (evicting the LRU unpinned resident if the pool is full)
+        on a miss. Callers must :meth:`release` the returned page at slot
+        eviction."""
+        self.requests += 1
+        if cohort not in self._bank:
+            raise KeyError(f"unknown adapter cohort {cohort!r}")
+        page = self._pages.get(cohort)
+        if page is not None:
+            self.hits += 1
+            del self._pages[cohort]  # re-insert: LRU recency order
+            self._pages[cohort] = page
+            self.allocator.retain([page])
+            return page
+        ids = self.allocator.alloc(1)
+        if ids is None:
+            victim = next(
+                (c for c, p in self._pages.items()
+                 if self.allocator.refcount(p) == 1),
+                None,
+            )
+            if victim is None:
+                raise RuntimeError(
+                    "adapter pool exhausted: every page is pinned by a live "
+                    "slot (caller must can_acquire first)"
+                )
+            self.allocator.free([self._pages.pop(victim)])
+            self.evictions += 1
+            ids = self.allocator.alloc(1)
+            assert ids is not None  # the eviction just freed a page
+        page = ids[0]
+        self._leaves = list(
+            self._write(
+                tuple(self._leaves),
+                jnp.int32(page),
+                tuple(jnp.asarray(a) for a in self._bank[cohort]),
+            )
+        )
+        self.loads += 1
+        self._pages[cohort] = page  # the index's own reference (alloc's 1)
+        self.allocator.retain([page])  # the caller's pin
+        return page
+
+    def release(self, page: int) -> None:
+        """Drop one slot's pin. The page stays resident (the index holds
+        its reference) until LRU pressure evicts it. Releasing a page with
+        no outstanding pin would silently consume the INDEX's reference
+        (a resident page would land on the free list while still mapped)
+        — that's an accounting bug, never user error, so it raises."""
+        from photon_tpu.serve.cache import BlockLeakError
+
+        if self.allocator.refcount(page) <= 1:
+            raise BlockLeakError(
+                f"releasing adapter page {page} with no outstanding pin"
+            )
+        self.allocator.free([page])
+
+    # -- step-side API ----------------------------------------------------
+    def leaves(self) -> tuple[jax.Array, ...]:
+        """The device page stacks, in canonical adapter-name order — passed
+        to the engine's jitted step as ARGUMENTS (closure capture would
+        retrace on every page load)."""
+        return tuple(self._leaves)
+
+    def stats(self) -> dict[str, float]:
+        return {
+            "residents": float(len(self._pages)),
+            "cohorts": float(len(self._bank)),
+            "loads": float(self.loads),
+            "evictions": float(self.evictions),
+            "hit_rate": (self.hits / self.requests) if self.requests else 0.0,
+        }

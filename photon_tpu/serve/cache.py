@@ -221,7 +221,8 @@ def mixed_chunk_step(params: dict, state: PagedState, tokens: jax.Array,
                      emit_off: jax.Array, lengths_after: jax.Array,
                      chunk_slot: jax.Array, cfg: ModelConfig, *, n_ctx: int,
                      has_chunk: bool = False, impl: str = "gather",
-                     interpret: bool = False) -> tuple[jax.Array, PagedState]:
+                     interpret: bool = False, adapters: dict | None = None,
+                     lora_scale: float = 1.0) -> tuple[jax.Array, PagedState]:
     """ONE serving program for a mixed chunked-prefill batch (ISSUE 12):
     every slot contributes a row of ``tokens [n_slots, Tq]`` — a decode
     row places its single last-emitted token in column 0 (rest padding),
@@ -273,7 +274,16 @@ def mixed_chunk_step(params: dict, state: PagedState, tokens: jax.Array,
     online-softmax Pallas kernel
     (``ops/ragged_paged_attention.py``) — the EPSILON tier
     (``interpret`` runs it through the Pallas interpreter off-TPU).
+
+    ``adapters`` (ISSUE 13): per-SLOT LoRA factors gathered from the
+    adapter pool — ``{module: {"a": [B, L, d_in, r], "b": [B, L, r,
+    d_out]}}``, scaled by ``lora_scale``. Row b's projections add row b's
+    delta (``models/decode._lora_delta``) — one mixed batch decodes
+    requests from different cohorts, and a trash-page row (all-zero
+    factors) decodes the bare base through the same graph. None keeps the
+    step byte-identical to the adapter-free build.
     """
+    from photon_tpu.models.decode import _layer_adapters
     from photon_tpu.ops.ragged_paged_attention import ragged_paged_attention
 
     n_kv = cfg.n_kv_heads or cfg.n_heads
@@ -306,12 +316,16 @@ def mixed_chunk_step(params: dict, state: PagedState, tokens: jax.Array,
 
     ck_l = jnp.moveaxis(state.cache_k, 1, 0)  # [L, NB, bs, H, D] view
     cv_l = jnp.moveaxis(state.cache_v, 1, 0)
+    ad_l = _layer_adapters(adapters)
 
     def layer(x, xs):
-        lp, ck, cv = xs  # ck/cv: [NB, bs, H_kv, Dh] — this layer's pool
+        if adapters is not None:
+            lp, ck, cv, la = xs
+        else:
+            (lp, ck, cv), la = xs, None  # ck/cv: [NB, bs, H_kv, Dh]
         h = _norm(x, lp["ln_1"]["scale"], lp["ln_1"].get("bias"),
                   cfg.norm, cfg.norm_eps)
-        q, k_new, v_new = _qkv(lp, h, cfg)  # q [B,Tq,H,Dh], k/v [B,Tq,Hkv,Dh]
+        q, k_new, v_new = _qkv(lp, h, cfg, la, lora_scale)  # q [B,Tq,H,Dh]
         if cfg.rope:
             q = _rope_at(q, positions, cfg.rope_theta)
             k_new = _rope_at(k_new, positions, cfg.rope_theta)
@@ -372,12 +386,15 @@ def mixed_chunk_step(params: dict, state: PagedState, tokens: jax.Array,
                 attn, out_c.astype(attn.dtype), chunk_slot, axis=0
             )
         x = x + _dense(lp, "out_proj",
-                       attn.reshape(n_slots, tq, cfg.d_model))
-        return _mlp(lp, x, cfg, token_mask=valid_f), (ck, cv)
+                       attn.reshape(n_slots, tq, cfg.d_model),
+                       la, lora_scale)
+        return _mlp(lp, x, cfg, token_mask=valid_f, la=la,
+                    ls=lora_scale), (ck, cv)
 
-    x, (ck_l, cv_l) = jax.lax.scan(
-        layer, x, (params["blocks"]["block"], ck_l, cv_l)
-    )
+    xs = (params["blocks"]["block"], ck_l, cv_l)
+    if adapters is not None:
+        xs = xs + (ad_l,)
+    x, (ck_l, cv_l) = jax.lax.scan(layer, x, xs)
     last = jnp.take_along_axis(x, emit_off[:, None, None], axis=1)[:, 0]
     return _logits(params, last, cfg), PagedState(
         cache_k=jnp.moveaxis(ck_l, 0, 1),

@@ -79,7 +79,16 @@ def load_serving_params(cfg: Config, mgr: Any, server_round: int) -> Any:
     """Params-only load + model-template restore for serving consumers
     (shared by :meth:`PagedEngine.from_checkpoint` and the hot-swap
     watcher, ``serve/hotswap.py``): no dead optimizer moments, aggregated
-    momenta split off when the run shipped them."""
+    momenta split off when the run shipped them.
+
+    The template is built with the LoRA knobs ZEROED: server checkpoints
+    store the adapter-free BASE (adapter runs save their base + separate
+    ``adapter__*`` objects; ``configure_adapter_training`` mutates the
+    TRAINING config's ``model.lora_*`` in place, and a serving consumer
+    handed that same config — or its YAML round-trip — must not demand
+    lora leaves the checkpoint never carries)."""
+    import dataclasses as _dc
+
     from photon_tpu.codec import params_from_ndarrays
     from photon_tpu.models.mpt import init_params
     from photon_tpu.train.param_ops import has_momenta, split_momenta
@@ -87,7 +96,10 @@ def load_serving_params(cfg: Config, mgr: Any, server_round: int) -> Any:
     meta, arrays = mgr.load_round_params(server_round)
     if has_momenta(meta):
         meta, arrays, _, _ = split_momenta(meta, arrays)
-    return params_from_ndarrays(init_params(cfg.model, seed=0), meta, arrays)
+    mc = cfg.model
+    if mc.lora_rank:
+        mc = _dc.replace(mc, lora_rank=0, lora_targets=())
+    return params_from_ndarrays(init_params(mc, seed=0), meta, arrays)
 
 
 @dataclass
@@ -104,7 +116,8 @@ class _Prefill:
 
 class PagedEngine:
     def __init__(self, cfg: Config, params: Any, *,
-                 loaded_round: int | None = None) -> None:
+                 loaded_round: int | None = None,
+                 adapter_bank: dict | None = None) -> None:
         self.cfg = cfg
         self.mc: ModelConfig = cfg.model
         sc = cfg.photon.serve
@@ -157,6 +170,35 @@ class PagedEngine:
             )
         # single-slot chain-hash memo (see _chain_hashes)
         self._hash_memo: tuple[list[int], int, list[bytes]] | None = None
+        # per-cohort LoRA plane (ISSUE 13, serve/adapter_pool.py): a second
+        # small paged pool beside the KV pool. MoE is rejected at config
+        # validation (batch-global expert capacity breaks per-slot adapter
+        # purity), so no silent-ineligible branch is needed here.
+        self.adapter_pool = None
+        self.adapter_scale = 1.0
+        ad = getattr(cfg.photon, "adapters", None)
+        if ad is not None and ad.enabled:
+            from photon_tpu.adapters.lora import spec_from_params
+            from photon_tpu.serve.adapter_pool import AdapterPool
+
+            spec = spec_from_params(
+                self.params, ad.rank, ad.alpha, tuple(ad.targets)
+            )
+            self.adapter_pool = AdapterPool(spec, ad.pool_size)
+            self.adapter_scale = spec.scale
+            if adapter_bank:
+                self.adapter_pool.install_bank(adapter_bank)
+        self._adapter_spec = (
+            self.adapter_pool.spec if self.adapter_pool is not None else None
+        )
+        #: per-slot adapter page (trash page = identity adapter); host
+        #: mirror of the row ids the step gathers through
+        self._adapter_rows = np.full(
+            self.n_slots,
+            self.adapter_pool.trash_page if self.adapter_pool else 0,
+            np.int32,
+        )
+        self._slot_cohort: list[str | None] = [None] * self.n_slots
         self.state: PagedState = init_paged_state(
             self.mc, self.n_slots, self.n_blocks, self.block_size, self.max_blocks
         )
@@ -169,16 +211,30 @@ class PagedEngine:
         self._pending: dict[int, _Prefill] = {}  # slot -> chunk cursor
         mc = self.mc
         use_kernel, interp = self._use_kernel, self._interpret
+        has_adapters = self.adapter_pool is not None
+        a_spec, a_scale = self._adapter_spec, self.adapter_scale
 
         def step_fn(params, state, tokens, positions, q_valid, emit_off,
                     emit_mask, lengths_after, chunk_slot, temps, keys,
-                    *, n_ctx, has_chunk):
+                    apool, arows, *, n_ctx, has_chunk):
+            adapters = None
+            if has_adapters:
+                # per-slot page gather (fixed shape: [B] rows into the
+                # [P+1, ...] page stacks — cohort churn never retraces).
+                # Pool leaves ride as ARGUMENTS: closure capture would
+                # recompile on every page load.
+                from photon_tpu.adapters.lora import adapter_tree
+
+                adapters = adapter_tree(
+                    a_spec, [leaf[arows] for leaf in apool]
+                )
             logits, state = mixed_chunk_step(
                 params, state, tokens, positions, q_valid, emit_off,
                 lengths_after, chunk_slot, mc, n_ctx=n_ctx,
                 has_chunk=has_chunk,
                 impl="ragged" if use_kernel else "gather",
                 interpret=interp,
+                adapters=adapters, lora_scale=a_scale,
             )
             sub = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
             nxt = _sample_rows(logits, temps, sub[:, 0])
@@ -207,15 +263,40 @@ class PagedEngine:
 
         store = store or FileStore(cfg.photon.save_path + "/store")
         mgr = ServerCheckpointManager(store, cfg.run_uuid)
-        rnd = mgr.resolve_resume_round(resume_round)
-        return cls(cfg, load_serving_params(cfg, mgr, rnd), loaded_round=rnd)
+        adapters_on = (getattr(cfg.photon, "adapters", None) is not None
+                       and cfg.photon.adapters.enabled)
+        # adapter mode: round validity includes every cohort's adapter
+        # object — a round missing one (cohort map grew since the save, or
+        # a pre-adapter phase of the run) falls back to an older valid
+        # round instead of crashing the daemon at the bank load
+        state_keys: tuple[str, ...] = ()
+        if adapters_on:
+            from photon_tpu.adapters.checkpoint import adapter_key
 
-    def set_params(self, params: Any, loaded_round: int | None = None) -> None:
+            state_keys = tuple(
+                adapter_key(c) for c in sorted(cfg.photon.adapters.cohorts)
+            )
+        rnd = mgr.resolve_resume_round(resume_round, state_keys)
+        bank = None
+        if adapters_on:
+            from photon_tpu.adapters.checkpoint import load_adapter_bank
+
+            bank = load_adapter_bank(mgr, rnd, cfg.photon.adapters.cohorts)
+        return cls(cfg, load_serving_params(cfg, mgr, rnd), loaded_round=rnd,
+                   adapter_bank=bank)
+
+    def set_params(self, params: Any, loaded_round: int | None = None,
+                   adapter_bank: dict | None = None) -> None:
         """The hot-swap reference assignment (ISSUE 11): install a new
         round's params. MUST be called from the scheduler driver thread at
         a swap point with zero active slots — in-flight requests always
         run end to end on one round's params. Flushes the prefix cache:
-        KV computed under the old params is invalid under the new."""
+        KV computed under the old params is invalid under the new.
+
+        ``adapter_bank`` (ISSUE 13) swaps the per-cohort adapters in the
+        SAME quiesced assignment — base and adapters move atomically, and
+        every resident pool page is dropped (factors trained against the
+        old base are invalid under the new)."""
         if self._active.any():
             raise RuntimeError(
                 f"param swap with {int(self._active.sum())} active slots — "
@@ -223,6 +304,8 @@ class PagedEngine:
             )
         self.params = jax.tree.map(jnp.asarray, params)
         self.loaded_round = loaded_round
+        if self.adapter_pool is not None and adapter_bank is not None:
+            self.adapter_pool.install_bank(adapter_bank)
         if self.prefix_cache is not None:
             self.prefix_cache.flush()
 
@@ -243,14 +326,26 @@ class PagedEngine:
                 and self.blocks_needed(prompt_len, max_new)
                 <= min(self.max_blocks, self.n_blocks))
 
+    def has_cohort(self, cohort: str) -> bool:
+        """Is ``cohort`` servable here (adapter plane on + bank entry)?"""
+        return (self.adapter_pool is not None
+                and self.adapter_pool.has_cohort(cohort))
+
     def can_admit(self, prompt_len: int, max_new: int,
-                  prompt: list[int] | None = None) -> bool:
+                  prompt: list[int] | None = None,
+                  cohort: str | None = None) -> bool:
         """With ``prompt`` given and the prefix cache on, admissibility
         accounts for cache hits (fewer fresh blocks needed) AND for
         reclaimable cache-held blocks (entries no live slot shares —
-        evictable under pressure by :meth:`begin`'s ``ensure_free``)."""
+        evictable under pressure by :meth:`begin`'s ``ensure_free``).
+        ``cohort`` additionally requires an acquirable adapter page
+        (resident, free, or LRU-evictable)."""
         if self.free_slot() is None:
             return False
+        if cohort is not None:
+            if self.adapter_pool is None \
+                    or not self.adapter_pool.can_acquire(cohort):
+                return False
         hit, fresh_needed, _ = self._prefix_plan(
             prompt if prompt is not None else [], prompt_len, max_new,
             touch=False,
@@ -325,6 +420,12 @@ class PagedEngine:
             "tokens_cached": pc.tokens_cached,
         }
 
+    def adapter_stats(self) -> dict[str, float] | None:
+        """Adapter-pool counters for /healthz and the KPI tick (None when
+        the adapter plane is off)."""
+        pool = self.adapter_pool
+        return None if pool is None else pool.stats()
+
     def attn_stats(self) -> dict[str, float]:
         """Attention-plane gauges for the scheduler's KPI tick: the live
         walk width, the pool's live fraction, and whether the ragged walk
@@ -365,7 +466,8 @@ class PagedEngine:
         return self._ctx_hw
 
     def begin(self, slot: int, prompt: list[int], max_new: int,
-              temperature: float = 0.0, seed: int = 0) -> None:
+              temperature: float = 0.0, seed: int = 0,
+              cohort: str | None = None) -> None:
         """Reserve ``slot`` for a request and stage its chunk stream —
         the cheap half of admission (no model compute): reserve the worst
         case ``blocks_needed(len, max_new)`` blocks up front (an admitted
@@ -387,6 +489,18 @@ class PagedEngine:
             raise ValueError(
                 f"request needs {n}+{max_new} tokens > slot capacity {self.s_cap}"
             )
+        apage: int | None = None
+        if cohort is not None:
+            if self.adapter_pool is None:
+                raise ValueError(
+                    f"request names cohort {cohort!r} but this server has "
+                    "no adapter plane (photon.adapters disabled)"
+                )
+            # pin the cohort's page FIRST (one allocator reference per
+            # slot; a miss loads it — evicting the LRU unpinned resident).
+            # Not a lock: a refcount checkout, released by evict() at slot
+            # teardown and by the except arm below on a failed admission.
+            apage = self.adapter_pool.acquire(cohort)  # photon-lint: ignore[concurrency]
         hit, fresh_needed, hashes = self._prefix_plan(prompt, n, max_new)
         k = len(hit)
         ids: list[int] | None = None
@@ -423,7 +537,14 @@ class PagedEngine:
                 self.allocator.free(ids)
             if retained:
                 self.allocator.free(hit)
+            if apage is not None:
+                self.adapter_pool.release(apage)
             raise
+        if self.adapter_pool is not None:
+            self._adapter_rows[slot] = (
+                apage if apage is not None else self.adapter_pool.trash_page
+            )
+        self._slot_cohort[slot] = cohort
         self._keys = self._keys.at[slot].set(jax.random.PRNGKey(seed))
         self._temps = self._temps.at[slot].set(float(temperature))
         self._slot_blocks[slot] = row_blocks
@@ -491,11 +612,14 @@ class PagedEngine:
             if final:
                 emit_off[cs] = cn - 1
                 emit_mask[cs] = True
+        pool = self.adapter_pool
         self.state, nxt, self._keys = self._mixed_call(
             self._ctx_width(), bool(seg), self.params, self.state,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(q_valid),
             jnp.asarray(emit_off), jnp.asarray(emit_mask),
             jnp.asarray(lengths_after), jnp.int32(cs), self._temps, self._keys,
+            pool.leaves() if pool is not None else (),
+            jnp.asarray(self._adapter_rows),
         )
         out = np.asarray(nxt)
         self._lengths = lengths_after
@@ -527,14 +651,16 @@ class PagedEngine:
             self.prefix_cache.insert(p.hashes, p.row_blocks[:full])
 
     def admit(self, slot: int, prompt: list[int], max_new: int,
-              temperature: float = 0.0, seed: int = 0) -> int:
+              temperature: float = 0.0, seed: int = 0,
+              cohort: str | None = None) -> int:
         """Synchronous admission (compat shim over the chunked flow, used
         by tests and offline callers): stage the request and run its whole
         suffix as ONE chunk — no decode ride-alongs, so batch-mates'
         streams don't advance — returning the first sampled token. The
         scheduler's chunked path (:meth:`begin` + budgeted
         :meth:`mixed_step`) is the serving-loop route."""
-        self.begin(slot, prompt, max_new, temperature=temperature, seed=seed)
+        self.begin(slot, prompt, max_new, temperature=temperature, seed=seed,
+                   cohort=cohort)
         first: int | None = None
         while self.pending_tokens(slot) > 0:
             nxt, emitted = self.mixed_step(
@@ -567,6 +693,12 @@ class PagedEngine:
         self.allocator.free(self._slot_blocks[slot])
         self._slot_blocks[slot] = []
         self._pending.pop(slot, None)
+        if self._slot_cohort[slot] is not None:
+            # drop this slot's pin; the page stays resident for the next
+            # same-cohort admission until LRU pressure evicts it
+            self.adapter_pool.release(int(self._adapter_rows[slot]))
+            self._adapter_rows[slot] = self.adapter_pool.trash_page
+            self._slot_cohort[slot] = None
         self._active[slot] = False
         self._last[slot] = 0
         self._lengths[slot] = 0

@@ -116,6 +116,10 @@ class ServeFrontend:
                     prefix = eng.prefix_stats()
                     if prefix is not None:
                         payload["prefix_cache"] = prefix
+                    ast = getattr(eng, "adapter_stats", None)
+                    if ast is not None and (a := ast()) is not None:
+                        a["serving"] = eng.adapter_pool.cohorts()
+                        payload["adapters"] = a
                     if fe.watcher is not None:
                         payload["hotswap"] = fe.watcher.stats()
                     self._json(200, payload)
@@ -188,11 +192,15 @@ class ServeFrontend:
                     max_new = min(int(body.get("max_new_tokens", fe.max_new_tokens_cap)),
                                   fe.max_new_tokens_cap)
                     eos = body.get("eos_id")
+                    cohort = body.get("cohort")
+                    if cohort is not None and not isinstance(cohort, str):
+                        raise ValueError("'cohort' must be a string")
                     req = fe.batcher.submit(
                         prompt, max_new,
                         temperature=float(body.get("temperature", 0.0)),
                         seed=int(body.get("seed", 0)),
                         eos_id=None if eos is None else int(eos),
+                        cohort=cohort,
                     )
                 except QueueFullError as e:
                     self._json(429, {"error": str(e)}, {"Retry-After": "1"})
@@ -345,6 +353,8 @@ class ServeFrontend:
             "ttft_s": round(req.ttft_s, 6),
             "total_s": round(max(0.0, req.t_done - req.t_submit), 6),
         }
+        if req.cohort is not None:
+            out["cohort"] = req.cohort
         if self.tokenizer is not None:
             out["text"] = self.tokenizer.decode(tokens)
         return out

@@ -166,8 +166,20 @@ class CheckpointWatcher:
             self._skip(candidate, "corrupt")
             return "skipped-corrupt"
         params = load_serving_params(self.cfg, self.mgr, candidate)
+        bank = None
+        ad = getattr(self.cfg.photon, "adapters", None)
+        if ad is not None and ad.enabled:
+            # base + per-cohort adapters swap ATOMICALLY (ISSUE 13): the
+            # bank rides the same staged swap, applied in one quiesced
+            # assignment — and it was just CRC-verified above with the
+            # rest of the round's objects (the manifest lists every
+            # adapter__*.npz)
+            from photon_tpu.adapters.checkpoint import load_adapter_bank
+
+            bank = load_adapter_bank(self.mgr, candidate, ad.cohorts)
         try:
-            done = self.batcher.request_swap(params, loaded_round=candidate)
+            done = self.batcher.request_swap(params, loaded_round=candidate,
+                                             adapter_bank=bank)
         except DrainingError:
             self._skip(candidate, "draining", warn=False)
             return "skipped-draining"

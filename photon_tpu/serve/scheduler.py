@@ -49,6 +49,11 @@ from photon_tpu.metrics.history import History
 from photon_tpu.serve.engine import PagedEngine
 from photon_tpu.utils.profiling import (
     EVENT_HOTSWAP_SWAPPED,
+    SERVE_ADAPTER_COHORTS,
+    SERVE_ADAPTER_EVICTIONS,
+    SERVE_ADAPTER_HIT_RATE,
+    SERVE_ADAPTER_LOADS,
+    SERVE_ADAPTER_RESIDENTS,
     SERVE_ATTN_CTX_BLOCKS,
     SERVE_ATTN_LIVE_FRAC,
     SERVE_ATTN_RAGGED,
@@ -100,6 +105,9 @@ class ServeRequest:
     temperature: float = 0.0
     seed: int = 0
     eos_id: int | None = None
+    #: adapter cohort (ISSUE 13): decode through this cohort's LoRA pages;
+    #: None = the bare base model
+    cohort: str | None = None
     t_submit: float = 0.0
     t_admit: float = 0.0
     t_first: float = 0.0
@@ -238,22 +246,27 @@ class ContinuousBatcher:
         return drained
 
     # -- live checkpoint hot-swap (ISSUE 11) ------------------------------
-    def request_swap(self, params, loaded_round: int | None = None
-                     ) -> threading.Event:
+    def request_swap(self, params, loaded_round: int | None = None,
+                     adapter_bank: dict | None = None) -> threading.Event:
         """Stage a parameter swap; returns an Event set once the driver
         thread has applied it. Ordering guarantees (docs/serving.md):
         admission pauses (queued/new requests wait — nothing is dropped),
         running slots finish their generations on the OLD params, then the
-        swap is one reference assignment and the prefix cache flushes. A
-        draining/stopped batcher refuses (:class:`DrainingError`) — the
-        watcher retries after the drain decision is final."""
+        swap is one reference assignment and the prefix cache flushes.
+        ``adapter_bank`` (ISSUE 13) rides the same staged tuple, so base
+        params and per-cohort adapters swap ATOMICALLY at the quiesced
+        point — a request can never decode new-base KV through old-base
+        adapters. A draining/stopped batcher refuses
+        (:class:`DrainingError`) — the watcher retries after the drain
+        decision is final."""
         with self._work:
             if self._stop or self._draining:
                 raise DrainingError("batcher draining/stopped: swap refused")
             if self._pending_swap is not None:
                 raise RuntimeError("a param swap is already pending")
             done = threading.Event()
-            self._pending_swap = (params, loaded_round, done, time.monotonic())
+            self._pending_swap = (params, loaded_round, done,
+                                  time.monotonic(), adapter_bank)
             self._work.notify_all()
         return done
 
@@ -273,10 +286,14 @@ class ContinuousBatcher:
             # CLAIM the swap under the lock: a drain() racing in after this
             # point finds nothing to abandon, so exactly one of {apply,
             # abandon} ever happens and done fires exactly once
-            params, rnd, done, t0 = self._pending_swap
+            params, rnd, done, t0, bank = self._pending_swap
             self._pending_swap = None
         try:
-            self.engine.set_params(params, loaded_round=rnd)
+            if bank is not None:
+                self.engine.set_params(params, loaded_round=rnd,
+                                       adapter_bank=bank)
+            else:
+                self.engine.set_params(params, loaded_round=rnd)
         except BaseException:
             # a failed apply must still release the waiter (it observes the
             # unchanged round and reports the abandon) — otherwise the
@@ -303,7 +320,8 @@ class ContinuousBatcher:
     # -- submission (any thread) ------------------------------------------
     def submit(self, prompt: list[int], max_new_tokens: int, *,
                temperature: float = 0.0, seed: int = 0,
-               eos_id: int | None = None) -> ServeRequest:
+               eos_id: int | None = None,
+               cohort: str | None = None) -> ServeRequest:
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -313,6 +331,18 @@ class ContinuousBatcher:
                 f"request needs {len(prompt)}+{max_new_tokens} tokens — over "
                 f"this server's context capacity"
             )
+        if cohort is not None:
+            # reject unknown cohorts at SUBMIT (the frontend's 400), not at
+            # admission: a queued unknown-cohort request could never admit
+            # and would FIFO head-block the queue forever
+            has = getattr(self.engine, "has_cohort", None)
+            if has is None or not has(cohort):
+                pool = getattr(self.engine, "adapter_pool", None)
+                known = pool.cohorts() if pool is not None else []
+                raise ValueError(
+                    f"unknown adapter cohort {cohort!r} — this server "
+                    f"serves {known}"
+                )
         # eos_id: None → server default; negative → explicitly no EOS
         eos = self.default_eos_id if eos_id is None else (
             None if eos_id < 0 else int(eos_id)
@@ -320,7 +350,7 @@ class ContinuousBatcher:
         req = ServeRequest(
             rid=next(self._rid), prompt=list(prompt),
             max_new_tokens=max_new_tokens, temperature=temperature, seed=seed,
-            eos_id=eos, t_submit=time.monotonic(),
+            eos_id=eos, cohort=cohort, t_submit=time.monotonic(),
         )
         with self._work:
             if self._stop:
@@ -370,6 +400,13 @@ class ContinuousBatcher:
             out[SERVE_PREFIX_SHARED_BLOCKS] = float(len(pc))
             out[SERVE_PREFIX_EVICTIONS] = float(pc.evictions)
             out[SERVE_PREFIX_TOKENS_CACHED] = float(pc.tokens_cached)
+        ast = getattr(self.engine, "adapter_stats", None)
+        if ast is not None and (a := ast()) is not None:
+            out[SERVE_ADAPTER_RESIDENTS] = a["residents"]
+            out[SERVE_ADAPTER_COHORTS] = a["cohorts"]
+            out[SERVE_ADAPTER_LOADS] = a["loads"]
+            out[SERVE_ADAPTER_EVICTIONS] = a["evictions"]
+            out[SERVE_ADAPTER_HIT_RATE] = a["hit_rate"]
         return out
 
     # -- driver loop -------------------------------------------------------
@@ -417,8 +454,13 @@ class ContinuousBatcher:
             if self.batch_synchronous and not wave_open:
                 return  # baseline: wait for the whole wave to drain
             slot = self.engine.free_slot()
+            # cohort kwarg only when the request names one: fake/minimal
+            # engines (tests, alternative backends) need not grow the
+            # adapter-plane signature
+            extra = {} if head.cohort is None else {"cohort": head.cohort}
             if slot is None or not self.engine.can_admit(
-                len(head.prompt), head.max_new_tokens, prompt=head.prompt
+                len(head.prompt), head.max_new_tokens, prompt=head.prompt,
+                **extra,
             ):
                 return  # FIFO head-blocking: nobody overtakes
             with self._lock:
@@ -430,7 +472,7 @@ class ContinuousBatcher:
                 # chunk stream, budget-bounded per step
                 self.engine.begin(
                     slot, req.prompt, req.max_new_tokens,
-                    temperature=req.temperature, seed=req.seed,
+                    temperature=req.temperature, seed=req.seed, **extra,
                 )
             except Exception as e:  # noqa: BLE001 — fail THIS request, keep serving
                 # engine.begin is transactional (blocks freed, slot released)
@@ -563,6 +605,17 @@ class ContinuousBatcher:
                 hub.gauge(SERVE_ATTN_RAGGED).set(stats[SERVE_ATTN_RAGGED])
             if SERVE_HOTSWAP_ROUND in stats:
                 hub.gauge(SERVE_HOTSWAP_ROUND).set(stats[SERVE_HOTSWAP_ROUND])
+            if SERVE_ADAPTER_RESIDENTS in stats:
+                hub.gauge(SERVE_ADAPTER_RESIDENTS).set(
+                    stats[SERVE_ADAPTER_RESIDENTS])
+                hub.gauge(SERVE_ADAPTER_COHORTS).set(
+                    stats[SERVE_ADAPTER_COHORTS])
+                hub.gauge(SERVE_ADAPTER_HIT_RATE).set(
+                    stats[SERVE_ADAPTER_HIT_RATE])
+                hub.counter(SERVE_ADAPTER_LOADS).inc_to(
+                    stats[SERVE_ADAPTER_LOADS])
+                hub.counter(SERVE_ADAPTER_EVICTIONS).inc_to(
+                    stats[SERVE_ADAPTER_EVICTIONS])
             if SERVE_PREFIX_HIT_RATE in stats:
                 hub.gauge(SERVE_PREFIX_HIT_RATE).set(
                     stats[SERVE_PREFIX_HIT_RATE])

@@ -5,6 +5,12 @@ from photon_tpu.strategy.aggregation import (  # noqa: F401
 )
 from photon_tpu.strategy.base import ClientResult, Strategy  # noqa: F401
 from photon_tpu.strategy.dispatcher import dispatch_strategy  # noqa: F401
+from photon_tpu.strategy.grouped import (  # noqa: F401
+    CohortStrategies,
+    cohort_of_map,
+    cohort_onehot,
+    grouped_host_fold,
+)
 from photon_tpu.strategy.optimizers import (  # noqa: F401
     FedAdam,
     FedAvgEff,

@@ -1,0 +1,162 @@
+"""Grouped (per-cohort) server strategies — ISSUE 13.
+
+One :class:`~photon_tpu.strategy.base.Strategy` instance per cohort, each
+holding that cohort's adapter parameters and optimizer state, driven by
+the SAME update rules as the global plane (``strategy/optimizers.py``) —
+per cohort, a personalization round is exactly a federated round over a
+tiny payload:
+
+    avg_c  = Σ_{k ∈ cohort c} n_k · a_k / Σ n_k      (the fused program,
+                                                      parallel/collective_agg
+                                                      .grouped_weighted_average)
+    g_c    = a_c − avg_c
+    a_c'   = server_update_c(g_c)                    (host; payloads are tiny)
+
+The host oracle for the fused reduction is the per-cohort
+:func:`grouped_host_fold` below (``aggregate_inplace`` per cohort — also
+the degradation floor of the elastic ladder). Snapshot/restore mirror the
+device plane's commit discipline: an attempt that fails after partially
+applying cohort updates rolls back to the round's start, so a retry can
+never double-step a cohort."""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import numpy as np
+
+from photon_tpu.config.schema import FLConfig
+from photon_tpu.strategy.aggregation import aggregate_inplace
+from photon_tpu.strategy.dispatcher import dispatch_strategy
+
+
+class CohortStrategies:
+    """Per-cohort server optimizers over adapter payloads."""
+
+    def __init__(self, fl_cfg: FLConfig, cohort_names: Iterable[str]) -> None:
+        self.names = sorted(cohort_names)
+        if not self.names:
+            raise ValueError("need at least one cohort")
+        self.strategies = {n: dispatch_strategy(fl_cfg) for n in self.names}
+
+    def __getitem__(self, cohort: str):
+        return self.strategies[cohort]
+
+    @property
+    def state_keys(self) -> tuple[str, ...]:
+        return next(iter(self.strategies.values())).state_keys
+
+    def index_of(self, cohort: str) -> int:
+        """The cohort's column in the fused program's onehot/average
+        stacks (sorted-name order, stable across rounds)."""
+        return self.names.index(cohort)
+
+    # -- lifecycle ---------------------------------------------------------
+    def initialize(self, adapters: dict[str, list[np.ndarray]],
+                   state: dict[str, dict[str, list[np.ndarray]]] | None = None,
+                   t: dict[str, int] | None = None) -> None:
+        for name in self.names:
+            if name not in adapters:
+                raise ValueError(f"no initial adapter for cohort {name!r}")
+            self.strategies[name].initialize(
+                adapters[name], (state or {}).get(name)
+            )
+            if t and name in t:
+                self.strategies[name]._t = int(t[name])
+
+    def params(self, cohort: str) -> list[np.ndarray]:
+        return self.strategies[cohort].current_parameters
+
+    def apply_average(self, server_round: int, cohort: str,
+                      avg: list[np.ndarray], n_samples: int,
+                      n_clients: int) -> dict[str, float]:
+        """One cohort's pseudo-gradient + server-optimizer step (exactly
+        ``Strategy.apply_average`` — bit-for-bit the global plane's
+        rule over the cohort's tiny payload)."""
+        return self.strategies[cohort].apply_average(
+            server_round, avg, n_samples, n_clients
+        )
+
+    # -- elasticity --------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Deep host copy of every cohort's params/state/_t — the rollback
+        point an elastic retry restores (a partially-applied grouped
+        attempt must never double-step the cohorts it did reach)."""
+        out = {}
+        for name, s in self.strategies.items():
+            out[name] = (
+                [a.copy() for a in (s.current_parameters or [])],
+                {k: [a.copy() for a in v] for k, v in s.state.items()},
+                int(getattr(s, "_t", 0)),
+            )
+        return out
+
+    def restore(self, snap: dict) -> None:
+        for name, (params, state, t) in snap.items():
+            s = self.strategies[name]
+            s.current_parameters = [a.copy() for a in params]
+            s.state = {k: [a.copy() for a in v] for k, v in state.items()}
+            if hasattr(s, "_t"):
+                s._t = t
+
+    # -- checkpoint bridges ------------------------------------------------
+    def adapters_for_checkpoint(self) -> dict[str, list[np.ndarray]]:
+        return {n: list(s.current_parameters) for n, s in self.strategies.items()}
+
+    def state_for_checkpoint(self) -> dict[str, dict[str, list[np.ndarray]]]:
+        return {n: s.state_for_checkpoint() for n, s in self.strategies.items()}
+
+    def t_counters(self) -> dict[str, int]:
+        return {n: int(getattr(s, "_t", 0)) for n, s in self.strategies.items()}
+
+    def restore_t(self, t: dict[str, int]) -> None:
+        for name, s in self.strategies.items():
+            if hasattr(s, "_t") and name in t:
+                s._t = int(t[name])
+
+
+def cohort_of_map(cohorts: dict[str, list[int]]) -> dict[int, str]:
+    """Config cohort map → cid lookup (validation already rejected
+    overlaps)."""
+    return {int(cid): name for name, cids in cohorts.items() for cid in cids}
+
+
+def cohort_onehot(cids: Iterable[int], cohort_of: dict[int, str],
+                  cohort_names: list[str]) -> np.ndarray:
+    """``[len(cids), K]`` 0/1 assignment rows for the fused program — a
+    cid in no cohort is an all-zero row (contributes nowhere)."""
+    cids = list(cids)  # materialize once: a generator must not be consumed
+    # by the len() below and then read empty by the loop
+    idx = {n: i for i, n in enumerate(cohort_names)}
+    out = np.zeros((len(cids), len(cohort_names)), np.float32)
+    for row, cid in enumerate(cids):
+        name = cohort_of.get(int(cid))
+        if name is not None:
+            out[row, idx[name]] = 1.0
+    return out
+
+
+def grouped_host_fold(
+    landed: dict[int, tuple[list[np.ndarray], int]],
+    cohort_of: dict[int, str],
+) -> dict[str, tuple[list[np.ndarray], int, int]]:
+    """Per-cohort host streaming fold over whichever adapter deltas landed
+    — the fused program's oracle AND the elastic ladder's degradation
+    floor (it IS ``aggregate_inplace`` per cohort, so a degraded
+    personalization round is bit-exact with the host plane). Returns
+    ``{cohort: (avg, Σn, n_clients)}`` for cohorts with ≥1 landed
+    member; silent cohorts are simply absent (their state must stay
+    untouched). ``aggregate_inplace`` never mutates the incoming arrays
+    (the fp64 accumulator is its own copy), so ``landed`` stays reusable."""
+    members: dict[str, list[int]] = {}
+    for cid in sorted(landed):
+        name = cohort_of.get(int(cid))
+        if name is not None:
+            members.setdefault(name, []).append(cid)
+    out: dict[str, tuple[list[np.ndarray], int, int]] = {}
+    for name, cids in members.items():
+        avg, n_total = aggregate_inplace(
+            (landed[cid][0], landed[cid][1]) for cid in cids
+        )
+        out[name] = (avg, n_total, len(cids))
+    return out

@@ -49,6 +49,7 @@ from collections import deque
 from typing import Any
 
 from photon_tpu.utils.profiling import (
+    ALERT_ADAPTER_COHORT,
     ALERT_DEGRADED_ROUNDS,
     ALERT_HBM_GROWTH,
     ALERT_NONFINITE,
@@ -322,4 +323,15 @@ class HealthMonitor:
         resume, failed async write): the run survived, the storage didn't."""
         return self.alert(
             ALERT_STORE_CORRUPT, plane="store", severity=DEGRADED, **attrs
+        )
+
+    def note_cohort_degraded(self, **attrs: Any) -> Alert:
+        """Personalization-plane degradation (ISSUE 13): an adapter cohort
+        lost every member for a round — that cohort's adapter stayed
+        frozen while the rest of the round proceeded. Degrades the
+        federation plane (it recovers when the cohort returns; a
+        whole-round failure still comes from the collective watchers)."""
+        return self.alert(
+            ALERT_ADAPTER_COHORT, plane="federation", severity=DEGRADED,
+            **attrs,
         )

@@ -195,6 +195,31 @@ SERVE_CHUNK_TOKENS = "serve/chunk_tokens_total"
 #: token budget — the giant prompts that used to monopolize a step)
 SERVE_CHUNK_SPLIT_PROMPTS = "serve/chunk_split_prompts_total"
 
+# -- per-cohort LoRA personalization plane (ISSUE 13, photon_tpu/adapters) --
+# Train side (federation/collective_round.py grouped rounds):
+#: cohorts whose adapters updated this round (fused grouped reduction OR
+#: the per-cohort host fold on the degraded path)
+ADAPTER_COHORTS = "server/adapter_cohorts"
+#: configured cohorts with ZERO surviving members this round — their
+#: adapters stayed untouched (per-cohort degradation: one cohort's dead
+#: clients never cost another cohort its round)
+ADAPTER_COHORTS_DEGRADED = "server/adapter_cohorts_degraded"
+#: modeled cross-slice bytes of this round's ADAPTER exchange (the
+#: ~1000x-under-full-model number the personalization plane exists for;
+#: equals server/collective_wire_bytes on adapter rounds)
+ADAPTER_WIRE_BYTES = "server/adapter_wire_bytes"
+# Serve side (serve/adapter_pool.py, tick-time from engine.adapter_stats):
+#: adapter pages currently resident on device
+SERVE_ADAPTER_RESIDENTS = "serve/adapter_residents"
+#: cohorts in the host bank (servable cohorts)
+SERVE_ADAPTER_COHORTS = "serve/adapter_cohorts"
+#: cumulative host→device page loads (cohort misses)
+SERVE_ADAPTER_LOADS = "serve/adapter_loads_total"
+#: cumulative page evictions (LRU pressure on the pool)
+SERVE_ADAPTER_EVICTIONS = "serve/adapter_evictions_total"
+#: fraction of cohort acquisitions served by a resident page
+SERVE_ADAPTER_HIT_RATE = "serve/adapter_hit_rate"
+
 # -- run-health observatory instruments (ISSUE 10, telemetry/metrics.py) --
 # Histogram instruments on the serve plane (typed-metric hub, NOT History
 # KPIs: a latest-value gauge can't show a distribution):
@@ -250,6 +275,9 @@ EVENT_COLLECTIVE_DEGRADED = "collective/degraded"
 #: fault-injector firings are ``chaos/<plan kind>`` (chaos/injector.py
 #: counters: tcp_drop, store_bitflip, crash, ...)
 CHAOS_EVENT_PREFIX = "chaos/"
+#: a configured adapter cohort had no surviving member this round — its
+#: adapter skipped the update while every other cohort proceeded
+EVENT_ADAPTER_COHORT_DEGRADED = "adapter/cohort_degraded"
 #: the hot-swap watcher applied a new round's params (ISSUE 11)
 EVENT_HOTSWAP_SWAPPED = "hotswap/swapped"
 #: the watcher skipped a candidate round (corrupt manifest, failing
@@ -272,6 +300,9 @@ ALERT_QUEUE_SATURATION = "alert/queue_saturation"
 ALERT_STORE_CORRUPT = "alert/store_corrupt"
 #: live HBM growing monotonically across a full sample window
 ALERT_HBM_GROWTH = "alert/hbm_growth"
+#: an adapter cohort lost every member for a round (personalization
+#: plane degradation — scoped to that cohort only, ISSUE 13)
+ALERT_ADAPTER_COHORT = "alert/adapter_cohort"
 
 #: dynamic metric-name families the registry can't enumerate statically:
 #: per-strategy-state norms (``server/{state_key}_norm``,
