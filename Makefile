@@ -1,7 +1,7 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test test-all bench bench-host bench-telemetry bench-collective bench-ragged bench-compare chaos chaos-collective telemetry-smoke serve-smoke adapters-smoke lint lint-tests native clean
+.PHONY: test test-all bench bench-host bench-telemetry bench-collective bench-zero1 bench-ragged bench-compare chaos chaos-collective telemetry-smoke serve-smoke adapters-smoke lint lint-tests native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
@@ -32,6 +32,16 @@ bench-telemetry:
 # asserts the >=3.5x modeled cross-slice byte reduction at q8
 bench-collective:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --collective
+
+# ZeRO-1 sharded server update + layout auto-tuner gate (ISSUE 14):
+# replicated vs sharded plane on an emulated (2 clients, 4 replica) CPU
+# mesh with a 125M-shaped [params|m1|m2] FedAdam payload — exit code
+# asserts per-rank server-state bytes <= (1/R + eps) of replicated at
+# R=4, update-leg wall no worse, bit-exact params, and the auto-tuner's
+# top-ranked layout matching the measured-fastest on >= 2 mesh shapes.
+# Lint preflight like the other smoke targets.
+bench-zero1: lint
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --zero1
 
 # ragged-paged-attention serving gate (ISSUE 12): tokens/s vs live-KV
 # fraction (ragged walk vs the PR 5 full-width gather — ragged must win

@@ -2144,6 +2144,255 @@ def collective_report(n_clients: int = 4, replica: int = 2,
         return None
 
 
+def zero1_report(n_clients: int = 2, replica: int = 4,
+                 budget_bytes: int | None = None,
+                 rounds: int = 3) -> dict | None:
+    """ZeRO-1 sharded vs replicated server update (ISSUE 14 tentpole) on an
+    emulated ``(2 clients, 4 replica)`` CPU mesh, plus the layout
+    auto-tuner's ranking-vs-measurement validation. Exit-code gates
+    (``--zero1`` / ``make bench-zero1``):
+
+    - per-rank server-state bytes on the sharded plane ≤ ``(1/R + ε)`` ×
+      the replicated plane's, at R=4, on a 125M-shaped ``[params|m1|m2]``
+      payload under FedAdam (params + 2 Adam moments — the state whose HBM
+      blocks the 1.3B recipe from living where the 125M one does);
+    - the sharded round + update-leg (post-update params all-gather +
+      state mirror fetch) wall time is no worse than replicated (CPU
+      emulation noise floor documented in PERF.md — the gate carries a
+      25% allowance; the HBM division is the point, the wall clock must
+      merely not regress);
+    - sharded params bit-exact vs the replicated plane after every round
+      (the elementwise-update argument, pinned here end-to-end);
+    - the auto-tuner's top-ranked layout matches the measured-fastest
+      layout (tiny-model Trainer steps) on >= 2 emulated mesh shapes.
+    """
+    try:
+        import numpy as np
+
+        if budget_bytes is None:
+            budget_bytes = int(os.environ.get("PHOTON_BENCH_ZERO1_BYTES",
+                                              8 << 20))
+        from photon_tpu.utils.compat import set_cpu_device_count
+
+        set_cpu_device_count(n_clients * replica)
+        import jax
+
+        if jax.device_count() < n_clients * replica:
+            log(f"zero1 report needs {n_clients * replica} devices, "
+                f"have {jax.device_count()} (backend initialized early?)")
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from photon_tpu.codec import flatten_params
+        from photon_tpu.config.schema import ModelConfig
+        from photon_tpu.models.mpt import init_params
+        from photon_tpu.parallel.collective_agg import (
+            CLIENT_AXIS,
+            DeviceAggregationPlane,
+            make_hierarchical_mesh,
+        )
+        from photon_tpu.strategy.optimizers import FedAdam
+
+        # 125M-shaped [params|m1|m2] payload subset (same eval_shape
+        # discipline as collective_report): big matrices AND ragged
+        # layernorm leaves, tripled into the aggregate_momenta layout
+        abstract = jax.eval_shape(lambda: init_params(ModelConfig(), seed=0))
+        _, leaves = flatten_params(abstract)
+        rng = np.random.default_rng(0)
+        shapes, sampled = [], 0
+        for leaf in leaves:
+            nbytes = int(np.prod(leaf.shape, dtype=np.int64)) * 4
+            if sampled + nbytes > budget_bytes:
+                continue
+            shapes.append(tuple(leaf.shape))
+            sampled += nbytes
+        n_p = len(shapes)
+        payload_shapes = shapes * 3  # [params|m1|m2]
+        nonneg_rows = tuple(range(2 * n_p, 3 * n_p))
+        init = [rng.normal(0, 0.02, s).astype(np.float32)
+                for s in payload_shapes]
+        for i in nonneg_rows:
+            init[i] = np.abs(init[i])
+        mesh = make_hierarchical_mesh(n_clients, replica)
+        sharding = NamedSharding(mesh, P(CLIENT_AXIS))
+
+        def round_data(rnd):
+            r = np.random.default_rng(1000 + rnd)
+            stacked = [
+                jax.device_put(
+                    np.stack([
+                        r.normal(0, 0.02, s).astype(np.float32)
+                        for _ in range(n_clients)
+                    ]),
+                    sharding,
+                )
+                for s in payload_shapes
+            ]
+            ns = jax.device_put(
+                r.integers(64, 512, n_clients).astype(np.int32), sharding
+            )
+            return stacked, ns
+
+        def run_mode(sharded):
+            strat = FedAdam(server_learning_rate=0.5, server_tau=1e-3)
+            strat.initialize([p.copy() for p in init])
+            plane = DeviceAggregationPlane(
+                mesh, strat, nonneg_rows=nonneg_rows, sharded=sharded,
+            )
+            data = [round_data(r) for r in range(rounds + 1)]
+            # warmup: compiles the fused program AND the update-leg fetch
+            plane.run_round(*data[0], lr=0.5)
+            plane.params_host(), plane.state_host()
+            best_round = best_update = None
+            for stacked, ns in data[1:]:
+                t0 = time.perf_counter()
+                plane.run_round(stacked, ns, lr=0.5)
+                dt = time.perf_counter() - t0
+                best_round = dt if best_round is None else min(best_round, dt)
+                t0 = time.perf_counter()
+                params = plane.params_host()
+                plane.state_host()
+                dt = time.perf_counter() - t0
+                best_update = dt if best_update is None else min(best_update, dt)
+            return {
+                "state_bytes_per_rank": plane.server_state_bytes_per_rank(),
+                "shard_frac": round(plane.shard_fraction(), 4),
+                "round_wall_s": round(best_round, 5),
+                "update_leg_wall_s": round(best_update, 5),
+                "allgather_s": round(plane.last_allgather_s, 5),
+            }, params
+
+        rep, params_rep = run_mode(False)
+        shd, params_shd = run_mode(True)
+        bit_exact = all(
+            np.array_equal(a, b) for a, b in zip(params_rep, params_shd)
+        )
+        report: dict = {
+            "n_clients": n_clients,
+            "replica": replica,
+            "payload_bytes_per_client": sampled * 3,
+            "n_leaves": len(payload_shapes),
+            "replicated": rep,
+            "sharded": shd,
+            "params_bit_exact": bool(bit_exact),
+            "state_bytes_reduction": round(
+                rep["state_bytes_per_rank"] / shd["state_bytes_per_rank"], 3
+            ),
+            "state_bytes_frac": round(
+                shd["state_bytes_per_rank"] / rep["state_bytes_per_rank"], 4
+            ),
+            "update_leg_ratio": round(
+                (shd["round_wall_s"] + shd["update_leg_wall_s"])
+                / max(rep["round_wall_s"] + rep["update_leg_wall_s"], 1e-9),
+                3,
+            ),
+        }
+        from photon_tpu.utils.profiling import (
+            OPT_ALLGATHER_TIME,
+            OPT_SHARD_FRAC,
+        )
+
+        report[OPT_SHARD_FRAC] = shd["shard_frac"]
+        report[OPT_ALLGATHER_TIME] = shd["allgather_s"]
+        report["autotune"] = _autotune_validation()
+        return report
+    except Exception as e:  # noqa: BLE001 — never cost the round its numbers
+        log(f"zero1 report failed: {type(e).__name__}: {e}")
+        return None
+
+
+def _autotune_validation() -> dict | None:
+    """Rank-vs-measure the layout auto-tuner (ISSUE 14b acceptance): on
+    each emulated mesh shape, the cost model ranks a candidate set and a
+    tiny-model Trainer measures real step times for the same candidates —
+    the tuner's top pick must be the measured-fastest (``match`` per
+    shape, ``match_all`` the gate). CPU emulation carries no real ICI, but
+    the ordering signal survives: a tensor/fsdp layout pays its extra
+    collectives in wall time on any backend."""
+    try:
+        import jax
+        import numpy as np
+
+        from photon_tpu.config.schema import (
+            Config,
+            MeshConfig,
+            ModelConfig,
+            OptimizerConfig,
+            SchedulerConfig,
+            TrainConfig,
+        )
+        from photon_tpu.parallel.autotune import estimate_layout
+        from photon_tpu.parallel.mesh import make_mesh
+        from photon_tpu.train.trainer import Trainer
+
+        tiny = ModelConfig(
+            d_model=64, n_layers=2, n_heads=4, max_seq_len=32, vocab_size=256,
+            attn_impl="xla", compute_dtype="float32",
+        )
+        gbs = 8
+        shapes = {
+            "4dev": [MeshConfig(data=4), MeshConfig(fsdp=4),
+                     MeshConfig(tensor=4)],
+            "8dev": [MeshConfig(data=8), MeshConfig(fsdp=8),
+                     MeshConfig(data=2, tensor=4)],
+        }
+        tokens = np.arange(gbs * 32, dtype=np.int32).reshape(gbs, 32) % 256
+        out: dict = {"shapes": {}}
+        match_all = True
+        for label, candidates in shapes.items():
+            n_dev = candidates[0].size
+            if len(jax.devices()) < n_dev:
+                continue
+            est, measured = {}, {}
+            for mc in candidates:
+                key = f"d{mc.data}f{mc.fsdp}t{mc.tensor}p{mc.pipe}"
+                est[key] = estimate_layout(tiny, mc, gbs).est_step_s
+                cfg = Config(
+                    model=tiny, mesh=mc,
+                    optimizer=OptimizerConfig(name="adamw", lr=1e-3),
+                    scheduler=SchedulerConfig(t_warmup=2, t_max=100),
+                    train=TrainConfig(
+                        global_batch_size=gbs,
+                        device_microbatch_size=max(
+                            1, gbs // (mc.data * mc.fsdp)),
+                    ),
+                )
+                trainer = Trainer(
+                    cfg, mesh=make_mesh(mc, devices=jax.devices()[:n_dev]),
+                    init_seed=0,
+                )
+                trainer.fit([tokens], duration_steps=1)  # warmup compile
+                best = None
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    trainer.fit([tokens], duration_steps=1)
+                    dt = time.perf_counter() - t0
+                    best = dt if best is None else min(best, dt)
+                measured[key] = round(best, 5)
+            top_est = min(est, key=est.get)
+            top_meas = min(measured, key=measured.get)
+            match = top_est == top_meas
+            match_all = match_all and match
+            out["shapes"][label] = {
+                "est_step_s": {k: round(v, 6) for k, v in est.items()},
+                "measured_step_s": measured,
+                "top_ranked": top_est,
+                "measured_fastest": top_meas,
+                "match": match,
+            }
+        out["match_all"] = bool(match_all and len(out["shapes"]) >= 2)
+        return out
+    except Exception as e:  # noqa: BLE001 — never cost the round its numbers
+        log(f"autotune validation failed: {type(e).__name__}: {e}")
+        return None
+
+
+def zero1_subprocess_report(timeout: int = 1200) -> dict | None:
+    """In-run bridge for :func:`zero1_report` (the emulated 8-device CPU
+    mesh must be configured before jax initializes)."""
+    return _child_report("--zero1", "zero1", timeout)
+
+
 def adapter_plane_report(n_clients: int = 8, n_cohorts: int = 4,
                          rank: int = 8, repeats: int = 3) -> dict | None:
     """Per-cohort LoRA personalization plane (ISSUE 13): the two headline
@@ -2358,6 +2607,9 @@ _COMPARE_GATES = (
     # fused-grouped-reduction win over K sequential reductions (ISSUE 13)
     (lambda p: _dig(p, ("adapters", "fused_speedup")),
      "adapters_fused_speedup", False),
+    # ZeRO-1 per-rank server-state byte reduction (ISSUE 14; ~R at R=4)
+    (lambda p: _dig(p, ("zero1", "state_bytes_reduction")),
+     "zero1_state_bytes_reduction", False),
 )
 
 
@@ -2840,6 +3092,16 @@ def run(platform: str) -> None:
             out["collective"] = cr
             emit(out)
 
+    # ZeRO-1 sharded server update + layout auto-tuner (ISSUE 14): per-rank
+    # server-state bytes sharded vs replicated, update-leg wall, and the
+    # tuner's rank-vs-measure validation (own child interpreter, same
+    # emulated-mesh reasoning as the collective report)
+    if os.environ.get("PHOTON_BENCH_SKIP_ZERO1") != "1":
+        zr = zero1_subprocess_report()
+        if zr is not None:
+            out["zero1"] = zr
+            emit(out)
+
     # per-cohort LoRA personalization plane (ISSUE 13): modeled adapter-vs-
     # full-model wire bytes + the fused-grouped-reduction win over K
     # sequential reductions (own child interpreter, same reasoning as the
@@ -2991,6 +3253,15 @@ def main() -> int:
                          "adapter wire bytes >= 50x below a full-model "
                          "exchange AND the fused K-cohort reduction beats "
                          "K sequential reductions (CPU-only)")
+    ap.add_argument("--zero1", action="store_true",
+                    help="ZeRO-1 sharded vs replicated server update "
+                         "(ISSUE 14) on an emulated (2, 4) CPU mesh with a "
+                         "125M-shaped [params|m1|m2] payload, plus the "
+                         "layout auto-tuner's rank-vs-measure validation; "
+                         "exits nonzero unless per-rank state bytes drop to "
+                         "<= (1/R + eps), the update leg is no worse, params "
+                         "stay bit-exact and the tuner's top pick is the "
+                         "measured-fastest on >= 2 mesh shapes")
     ap.add_argument("--collective", action="store_true",
                     help="run only the device-collective aggregation report "
                          "(flat fp32 vs hierarchical q8 on an emulated CPU "
@@ -3065,6 +3336,24 @@ def main() -> int:
         return 0 if (ar is not None
                      and ar.get("wire_bytes_reduction", 0.0) >= 50.0
                      and ar.get("fused_speedup", 0.0) > 1.0) else 1
+    if args.zero1:
+        # CPU-jax only, fresh backend (emulated mesh before jax init — the
+        # in-run bench reaches this through zero1_subprocess_report). Exit
+        # gate (ISSUE 14): per-rank server-state bytes <= (1/R + eps) of
+        # replicated at R=4, update leg no worse (25% CPU-noise allowance),
+        # params bit-exact, and the auto-tuner's top-ranked layout is the
+        # measured-fastest on >= 2 emulated mesh shapes.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        zr = zero1_report()
+        emit({"zero1": zr})
+        if zr is None:
+            return 1
+        eps = 0.05
+        bytes_ok = zr["state_bytes_frac"] <= 1.0 / zr["replica"] + eps
+        wall_ok = zr["update_leg_ratio"] <= 1.25
+        tuner = zr.get("autotune") or {}
+        return 0 if (bytes_ok and wall_ok and zr["params_bit_exact"]
+                     and tuner.get("match_all")) else 1
     if args.collective:
         # CPU-jax only, fresh backend — the emulated client mesh must be
         # configured before jax initializes, which is why the in-run bench

@@ -155,6 +155,11 @@ class MeshConfig:
     sequence: int = 1
     pipe: int = 1
     expert: int = 1
+    # what make_mesh does when the device list does NOT divide evenly into
+    # meshes of this size (it uses devices[:size]; a non-multiple surplus
+    # usually means a mis-sized mesh silently wasting chips): "warn"
+    # (default), "error", or "ignore" (the pre-ISSUE-14 silence)
+    surplus_devices: str = "warn"
 
     @property
     def size(self) -> int:
@@ -246,6 +251,14 @@ class CommStackConfig:
       pseudo-gradient → server-optimizer round as ONE fused jitted SPMD
       program with optimizer state resident on device (all five
       strategies); off keeps the host-side strategy fold.
+    - ``collective_zero1``: ZeRO-1 cross-replica sharding of the device
+      optimizer (ISSUE 14, default on): params + optimizer moments live
+      sharded ``P(replica)`` between rounds, the update runs on each
+      rank's reduce-scatter shard, and ONE ICI all-gather reassembles the
+      updated params after the update — per-rank server-state HBM and
+      update FLOPs divide by ``collective_replica``. Bit-identical to the
+      replicated plane (pinned by test); turn off to keep the PR 7
+      replicated layout (no win at replica=1 or for tiny models).
 
     Elasticity knobs (ISSUE 8 — ``federation/collective_round.py``'s
     straggler/degradation ladder):
@@ -271,6 +284,7 @@ class CommStackConfig:
     collective_quantization: str = "off"  # off | q8
     collective_q8_block: int = 0  # 0 → compression DEFAULT_BLOCK (256)
     collective_device_optimizer: bool = False
+    collective_zero1: bool = True  # ZeRO-1 shard the device optimizer state
     collective_stage_timeout_s: float = 0.0  # 0 = no stage deadlines
     collective_quorum: float = 0.5  # min surviving fraction for the collective
     collective_retry_budget: int = 1  # reconfig attempts before host fallback
@@ -556,6 +570,14 @@ class PhotonConfig:
     # (the degenerate config — inline, zero threads).
     # Results are bit-identical across settings; only wall-clock moves.
     host_threads: int = 0
+    # heterogeneity-aware layout auto-tuner (parallel/autotune.py, ISSUE
+    # 14): when on, a Trainer built WITHOUT an explicit mesh derives its
+    # (data, fsdp, tensor, pipe) layout from the analytic cost model over
+    # its local device slice instead of the hand-set ``mesh`` block — each
+    # federated client on uneven hardware gets its own best layout (AMP,
+    # PAPERS.md). The chosen layout + search time land in the KPIs
+    # server/layout_{search_time,est_step_s}.
+    mesh_autotune: bool = False
     checkpoint: bool = True
     checkpoint_interval: int = 1
     # write round checkpoints on a background thread so round N+1's
@@ -1005,16 +1027,22 @@ class Config:
             or cs.collective_replica != 1
             or cs.collective_q8_block != 0
             or cs.collective_device_optimizer
+            or not cs.collective_zero1
             or cs.collective_stage_timeout_s != 0.0
             or cs.collective_quorum != 0.5
             or cs.collective_retry_budget != 1
         ):
             raise ValueError(
                 "comm_stack.collective_{quantization,replica,q8_block,"
-                "device_optimizer,stage_timeout_s,quorum,retry_budget} "
+                "device_optimizer,zero1,stage_timeout_s,quorum,retry_budget} "
                 "shape the collective aggregation plane — set "
                 "comm_stack.collective=true (the driver topologies "
                 "would silently ignore them)"
+            )
+        if self.mesh.surplus_devices not in ("warn", "error", "ignore"):
+            raise ValueError(
+                f"mesh.surplus_devices must be one of ('warn', 'error', "
+                f"'ignore'), got {self.mesh.surplus_devices!r}"
             )
         _ = self.model.d_head
         return self

@@ -114,6 +114,10 @@ from photon_tpu.utils.profiling import (
     HBM_BYTES_IN_USE,
     HBM_PEAK_BYTES,
     COMPILES_TOTAL,
+    LAYOUT_EST_STEP_S,
+    LAYOUT_SEARCH_TIME,
+    OPT_ALLGATHER_TIME,
+    OPT_SHARD_FRAC,
     ROUND_FAILED,
     ROUND_TIME,
     STEPS_CUMULATIVE,
@@ -298,11 +302,42 @@ class CollectiveFedRunner:
             DeviceAggregationPlane(
                 self.mesh, self.strategy,
                 quantization=self.quantization, block=self.q8_block,
-                nonneg_rows=self._nonneg_rows,
+                nonneg_rows=self._nonneg_rows, sharded=cs.collective_zero1,
             )
             if cs.collective_device_optimizer
             else None
         )
+        # heterogeneity-aware layout auto-tune (ISSUE 14b): rank the legal
+        # (data, fsdp, tensor, pipe) layouts for ONE client slice
+        # (collective_replica ICI ranks) with the analytic cost model and
+        # record the search into every round's metrics, so the History
+        # carries what the model predicts for this hardware (the driver
+        # topology's Trainer additionally USES the tuned layout when built
+        # without an explicit mesh — see train/trainer.py)
+        self._layout_metrics: dict[str, float] = {}
+        if cfg.photon.mesh_autotune:
+            from photon_tpu.parallel.autotune import autotune_layout
+
+            t0 = time.monotonic()
+            try:
+                best = autotune_layout(
+                    cfg.model,
+                    n_devices=max(1, cs.collective_replica),
+                    global_batch_size=cfg.train.global_batch_size,
+                )
+                self._layout_metrics = {
+                    LAYOUT_SEARCH_TIME: time.monotonic() - t0,
+                    LAYOUT_EST_STEP_S: float(best.est_step_s),
+                }
+            except ValueError as e:
+                # this probe only feeds the server/layout_* KPIs — the
+                # collective plane does not consume the layout, so "no
+                # legal layout for this slice shape" must not kill a run
+                # that would train fine (the loud-error contract belongs
+                # to the Trainer path, which does consume it)
+                warnings.warn(
+                    f"layout auto-tune probe skipped: {e}", stacklevel=2
+                )
         self.history = History()
         self.server_steps_cumulative = 0
         # per-client control state (sample/step counters), exactly as the
@@ -589,6 +624,7 @@ class CollectiveFedRunner:
         metrics[FIT_ROUND_TIME] = time.monotonic() - t_fit
         metrics[STEPS_CUMULATIVE] = float(self.server_steps_cumulative)
         metrics[ROUND_TIME] = time.monotonic() - t_round
+        metrics.update(self._layout_metrics)
         self.stragglers_total += stragglers
         if path == "host_fallback":
             self.degraded_rounds_total += 1
@@ -843,6 +879,11 @@ class CollectiveFedRunner:
                 self.strategy.restore_optimizer_state(state_host, t=t)
                 self.strategy.server_round = server_round
                 update_s = time.monotonic() - t_stage
+            # ZeRO-1 observability (ISSUE 14a): how much of the server
+            # state this rank holds, and what the post-update params
+            # all-gather cost inside the fetch above
+            metrics[OPT_SHARD_FRAC] = self.device_plane.shard_fraction()
+            metrics[OPT_ALLGATHER_TIME] = self.device_plane.last_allgather_s
         else:
             # host-optimizer path (and every partial-cohort attempt): the
             # collective carries the (optionally quantized) average; the
@@ -1522,6 +1563,7 @@ class CollectiveFedRunner:
                 self.mesh, self.strategy,
                 quantization=self.quantization, block=self.q8_block,
                 nonneg_rows=self._nonneg_rows,
+                sharded=self.cfg.photon.comm_stack.collective_zero1,
             )
 
     def evaluate_round(self, server_round: int) -> dict[str, float]:

@@ -54,6 +54,7 @@ the reference's float accumulation (``aggregate_inplace``).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Sequence
 
 import jax
@@ -149,32 +150,34 @@ def _check_one_row(ns_shape: tuple) -> None:
         )
 
 
-def _make_reduce_leaf(mesh: Mesh, quantization: str, block: int) -> Callable:
-    """Shared cross-client reduction body (flat psum / hierarchical
-    two-stage / q8 DCN leg) — the single construction point for the plain
-    weighted average AND the grouped per-cohort average (ISSUE 13), so the
-    grouped program inherits the exact wire semantics (and error bounds)
-    the PR 7 plane pinned."""
+def _chunk_len(n: int, replica: int, quantization: str, block: int) -> int:
+    """Per-rank chunk length of one flattened leaf under the reduce-scatter
+    layout: block-aligned on the q8 policy (the encode never sees a ragged
+    tail inside the collective), plain ceil-division otherwise. The SAME
+    function sizes the sharded optimizer-state layout (ZeRO-1, ISSUE 14),
+    so the state shards line up with the reduce-scatter output by
+    construction — and because block boundaries stay aligned to the global
+    padded vector for every ``replica``, the q8 scales (and therefore the
+    averaged values) are bit-identical across a resharding."""
+    if quantization == "q8":
+        return -(-n // (replica * block)) * block
+    return -(-n // replica)
+
+
+def _make_reduce_to_shard(mesh: Mesh, quantization: str, block: int) -> Callable:
+    """Cross-client reduction of one leaf's weighted contribution, returning
+    THIS RANK's chunk of the summed flat vector — the ICI reduce-scatter +
+    (optionally q8) DCN leg of :func:`_make_reduce_leaf` WITHOUT the trailing
+    ICI all-gather. The ZeRO-1 plane (ISSUE 14) consumes the shard directly:
+    the server update runs on it and only the updated params are gathered."""
     n_clients = int(mesh.shape[CLIENT_AXIS])
     replica = mesh_replica(mesh)
     has_replica = REPLICA_AXIS in mesh.axis_names
 
-    def _reduce_leaf(contrib: jnp.ndarray) -> jnp.ndarray:
-        """Weighted per-client contribution (one full row, replicated over
-        the ICI axis) → cross-client sum, replicated."""
-        shape = contrib.shape
-        if replica == 1 and quantization == "off":
-            # degenerate flat path: one fp32 psum, bit-compatible with the
-            # original 1-D program
-            return jax.lax.psum(contrib, CLIENT_AXIS)
+    def _reduce_to_shard(contrib: jnp.ndarray) -> jnp.ndarray:
         flat = contrib.reshape(-1)
         n = flat.size
-        if quantization == "q8":
-            # block-aligned chunks by construction: the q8 encode below
-            # never sees a ragged tail inside the collective
-            chunk = -(-n // (replica * block)) * block
-        else:
-            chunk = -(-n // replica)
+        chunk = _chunk_len(n, replica, quantization, block)
         pad = replica * chunk - n
         if pad:
             flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
@@ -200,6 +203,31 @@ def _make_reduce_leaf(mesh: Mesh, quantization: str, block: int) -> Callable:
             red = (grid * all_scales[:, :, None]).sum(axis=0).reshape(-1)
         else:
             red = jax.lax.psum(mychunk, CLIENT_AXIS)
+        return red
+
+    return _reduce_to_shard
+
+
+def _make_reduce_leaf(mesh: Mesh, quantization: str, block: int) -> Callable:
+    """Shared cross-client reduction body (flat psum / hierarchical
+    two-stage / q8 DCN leg) — the single construction point for the plain
+    weighted average AND the grouped per-cohort average (ISSUE 13), so the
+    grouped program inherits the exact wire semantics (and error bounds)
+    the PR 7 plane pinned."""
+    replica = mesh_replica(mesh)
+    has_replica = REPLICA_AXIS in mesh.axis_names
+    _reduce_to_shard = _make_reduce_to_shard(mesh, quantization, block)
+
+    def _reduce_leaf(contrib: jnp.ndarray) -> jnp.ndarray:
+        """Weighted per-client contribution (one full row, replicated over
+        the ICI axis) → cross-client sum, replicated."""
+        shape = contrib.shape
+        if replica == 1 and quantization == "off":
+            # degenerate flat path: one fp32 psum, bit-compatible with the
+            # original 1-D program
+            return jax.lax.psum(contrib, CLIENT_AXIS)
+        n = contrib.size
+        red = _reduce_to_shard(contrib)
         if has_replica:
             # ICI all-gather reassembles the full replicated vector
             red = jax.lax.all_gather(red, REPLICA_AXIS, tiled=True)
@@ -261,6 +289,34 @@ def _average_program(
     return prog
 
 
+#: (mesh, n_arrays) → jitted ICI all-gather program reassembling flat
+#: REPLICA_AXIS-sharded arrays into replicated ones (the ZeRO-1 plane's
+#: post-update params gather and the checkpoint-time state gather). Cached
+#: for the same reason as _AVG_PROGRAMS: a fresh shard_map per call would
+#: retrace every round.
+_GATHER_PROGRAMS: dict[tuple, Callable] = {}
+
+
+def _gather_program(mesh: Mesh, n_arrays: int) -> Callable:
+    key = (mesh, n_arrays)
+    prog = _GATHER_PROGRAMS.get(key)
+    if prog is None:
+
+        def local(*xs):
+            return tuple(
+                jax.lax.all_gather(x, REPLICA_AXIS, tiled=True) for x in xs
+            )
+
+        mapped = _full_shard_map(
+            local,
+            mesh,
+            in_specs=tuple(P(REPLICA_AXIS) for _ in range(n_arrays)),
+            out_specs=tuple(P() for _ in range(n_arrays)),
+        )
+        prog = _GATHER_PROGRAMS[key] = jax.jit(mapped)
+    return prog
+
+
 def evict_mesh_programs(mesh: Mesh) -> None:
     """Drop every cached average program built over ``mesh``. Pair with
     evicting the mesh itself (e.g. the collective runner's bounded
@@ -270,6 +326,8 @@ def evict_mesh_programs(mesh: Mesh) -> None:
         del _AVG_PROGRAMS[key]
     for key in [k for k in _GROUPED_PROGRAMS if k[0] is mesh]:
         del _GROUPED_PROGRAMS[key]
+    for key in [k for k in _GATHER_PROGRAMS if k[0] is mesh]:
+        del _GATHER_PROGRAMS[key]
 
 
 # ---------------------------------------------------------------------------
@@ -557,8 +615,26 @@ def device_server_update(
 class DeviceAggregationPlane:
     """The fused server round as ONE jitted SPMD program: hierarchical
     (optionally q8-quantized) weighted average → pseudo-gradient → server
-    optimizer update, with parameters AND optimizer state living as
-    replicated device arrays between rounds.
+    optimizer update.
+
+    **ZeRO-1 sharding (ISSUE 14, the default).** With ``sharded=True``,
+    parameters and optimizer moments live between rounds as padded-and-
+    flattened fp32 device arrays sharded ``P(REPLICA_AXIS)`` — each ICI
+    rank owns ``1/replica`` of every leaf (the exact reduce-scatter chunk
+    layout, :func:`_chunk_len`). The round program keeps the weighted
+    average's reduce-scatter output ON the rank's shard: pseudo-gradient,
+    all five update rules, the q8 ``nonneg_rows`` clamp and the norm
+    telemetry all run sharded, and ONE ICI all-gather reassembles only the
+    updated params (after the update — grounded in "Automatic Cross-Replica
+    Sharding of Weight Update in Data-Parallel Training", PAPERS.md). Per-
+    rank server-state HBM and update FLOPs divide by ``replica`` instead of
+    replicating; the update arithmetic is elementwise, so the sharded round
+    is bit-identical to the replicated one (pinned by test), and because
+    the padded-flat layout is value-preserving, checkpoints round-trip
+    bit-exactly across a resharding (save at replica=4, resume at
+    replica=1, and vice versa). ``sharded=False`` keeps the PR 7 replicated
+    layout (still the right call at ``replica=1`` or for tiny models —
+    PERF.md).
 
     The host :class:`~photon_tpu.strategy.base.Strategy` instance supplies
     the rule name + hyperparameters and stays the checkpoint authority:
@@ -575,6 +651,7 @@ class DeviceAggregationPlane:
         quantization: str = "off",
         block: int = DEFAULT_BLOCK,
         nonneg_rows: Sequence[int] = (),
+        sharded: bool = True,
     ) -> None:
         if strategy.name not in DEVICE_RULES:
             raise ValueError(
@@ -607,6 +684,27 @@ class DeviceAggregationPlane:
         #: from a restored strategy so resume keeps ``1 − β^t`` continuous
         self.t = int(getattr(strategy, "_t", 0))
         self._replicated = NamedSharding(mesh, P())
+        self.sharded = bool(sharded)
+        self.replica = mesh_replica(mesh)
+        self._has_replica = REPLICA_AXIS in mesh.axis_names
+        #: the between-rounds layout of the sharded plane: P(REPLICA_AXIS)
+        #: over the padded flat vector (replicated across the client axis);
+        #: degenerates to replicated on a flat 1-D client mesh
+        self._shard_sharding = NamedSharding(
+            mesh, P(REPLICA_AXIS) if self._has_replica else P()
+        )
+        #: per-leaf layout metadata (shared by seeding, the fused program,
+        #: the host bridges and the byte accounting): original shape/size
+        #: and the per-rank chunk length of the padded flat layout
+        self._shapes = [tuple(np.shape(p)) for p in strategy.current_parameters]
+        self._sizes = [int(np.prod(s, dtype=np.int64)) for s in self._shapes]
+        self._chunks = [
+            _chunk_len(n, self.replica, quantization, int(block))
+            for n in self._sizes
+        ]
+        #: wall seconds of the last post-update params all-gather + fetch
+        #: (``server/opt_allgather_time``; 0 until the first params_host)
+        self.last_allgather_s = 0.0
         n_rows = len(strategy.current_parameters)
         if any(not 0 <= int(i) < n_rows for i in nonneg_rows):
             raise ValueError(
@@ -634,10 +732,71 @@ class DeviceAggregationPlane:
         self._epoch = 0
         self._commit_lock = threading.Lock()
 
+    def _put_leaf_sharded(self, leaf: np.ndarray | None, i: int) -> jax.Array:
+        """Seed ONE leaf directly into its target padded-flat sharded layout
+        (``None`` = zero-fill, for missing optimizer state). No intermediate
+        full-size host copy is materialized (ISSUE 14 satellite): the
+        callback hands jax per-shard views of the flat leaf, and only a
+        shard that straddles the padding (or a zero leaf) allocates — one
+        chunk at a time, so peak host RSS during plane construction is
+        O(largest chunk), not O(payload). Pinned by a tracemalloc test."""
+        n, chunk = self._sizes[i], self._chunks[i]
+        padded = self.replica * chunk
+        flat = None
+        if leaf is not None:
+            flat = np.asarray(leaf, np.float32).reshape(-1)
+
+        def cb(index):
+            sl = index[0] if index else slice(None)
+            start = sl.start or 0
+            stop = padded if sl.stop is None else sl.stop
+            if flat is None:
+                # all-zero shards are identical: every one aliases the SAME
+                # read-only buffer (device arrays are immutable, the buffer
+                # is never written) — one chunk of host RSS, not one per
+                # shard per tensor
+                return self._zero_chunk(stop - start)
+            if stop <= n:
+                return flat[start:stop]  # a view — no copy
+            out = np.zeros(stop - start, np.float32)
+            if start < n:
+                out[: n - start] = flat[start:n]
+            return out
+
+        return jax.make_array_from_callback((padded,), self._shard_sharding, cb)
+
+    def _zero_chunk(self, length: int) -> np.ndarray:
+        """Shared zero buffer for zero-filled shards (views of one
+        allocation; callers must treat it as read-only — it may be aliased
+        into many device arrays on the CPU backend)."""
+        buf = getattr(self, "_zero_buf", None)
+        if buf is None or buf.size < length:
+            self._zero_buf = buf = np.zeros(
+                max(length, max(self._chunks, default=0)), np.float32
+            )
+        return buf[:length]
+
     def _seed_from_host(self, strategy: Any) -> None:
         """Device-put params + optimizer state from the host strategy (the
         single seeding point shared by ``__init__`` and
-        :meth:`reseed_from`); missing state keys seed zero-filled."""
+        :meth:`reseed_from`); missing state keys seed zero-filled. On the
+        sharded (ZeRO-1) plane every leaf lands directly in its padded-flat
+        ``P(REPLICA_AXIS)`` layout via :meth:`_put_leaf_sharded`."""
+        if self.sharded:
+            self.params = [
+                self._put_leaf_sharded(p, i)
+                for i, p in enumerate(strategy.current_parameters)
+            ]
+            self.state = {}
+            for key in self.state_keys:
+                host = strategy.state.get(key)
+                self.state[key] = [
+                    self._put_leaf_sharded(
+                        host[i] if host is not None else None, i
+                    )
+                    for i in range(len(self._sizes))
+                ]
+            return
         self.params = [
             jax.device_put(np.asarray(p, np.float32), self._replicated)
             for p in strategy.current_parameters
@@ -692,6 +851,85 @@ class DeviceAggregationPlane:
 
         return jax.jit(program)
 
+    def _build_sharded_program(self, n_leaves: int) -> Callable:
+        """The ZeRO-1 fused round (ISSUE 14): ONE shard_map'd program in
+        which the weighted average's reduce-scatter output STAYS on each
+        rank's chunk — pseudo-gradient, update rule, q8 clamp and norm
+        telemetry all run sharded — and only the n_total/norm scalars leave
+        replicated. Params are NOT gathered here: the post-update ICI
+        all-gather runs on demand in :meth:`params_host` (the update leg),
+        so between rounds every server-state tensor occupies 1/replica of a
+        rank's HBM. Flat positional calling convention (shard_map in_specs
+        are per-argument): ``(ns, *stacked, *param_shards, *state_shards,
+        lr, b1t, b2t)``."""
+        mesh = self.mesh
+        rule, hyper = self.rule, dict(self.hyper)
+        state_keys = self.state_keys
+        n_state = len(state_keys)
+        clamp_rows = (
+            frozenset(self.nonneg_rows) if self.quantization == "q8" else frozenset()
+        )
+        reduce_to_shard = _make_reduce_to_shard(mesh, self.quantization, self.block)
+        has_replica = self._has_replica
+        shard_spec = P(REPLICA_AXIS) if has_replica else P()
+
+        def local(*args):
+            ns = args[0]
+            stacked = args[1 : 1 + n_leaves]
+            params = list(args[1 + n_leaves : 1 + 2 * n_leaves])
+            state_flat = args[1 + 2 * n_leaves : 1 + (2 + n_state) * n_leaves]
+            lr, b1t, b2t = args[-3:]
+            _check_one_row(ns.shape)
+            n_total = jax.lax.psum(jnp.sum(ns.astype(jnp.float32)), CLIENT_AXIS)
+            w = ns[0].astype(jnp.float32) / n_total
+            # the reduce-scatter output IS the rank's share of the average:
+            # no all-gather before the update (the tentpole move)
+            avg = [
+                reduce_to_shard(leaf[0].astype(jnp.float32) * w)
+                for leaf in stacked
+            ]
+            grads = [x - a for x, a in zip(params, avg)]
+            state = {
+                key: list(state_flat[j * n_leaves : (j + 1) * n_leaves])
+                for j, key in enumerate(state_keys)
+            }
+            new_params, new_state = device_server_update(
+                rule, params, grads, state, lr, b1t, b2t, **hyper
+            )
+            if clamp_rows:
+                # restore the second-moment invariant the q8 noise breaks
+                # (see __init__); padding stays exactly 0 under max(·, 0)
+                new_params = [
+                    jnp.maximum(p, 0.0) if i in clamp_rows else p
+                    for i, p in enumerate(new_params)
+                ]
+
+            def _sq(tensors):
+                # per-shard partial squared sums; the ICI psum reassembles
+                # the global value (padding contributes exact zeros)
+                s = sum(jnp.sum(jnp.square(t)) for t in tensors)
+                return jax.lax.psum(s, REPLICA_AXIS) if has_replica else s
+
+            sq = [_sq(grads), _sq(params)]
+            for key in state_keys:
+                sq.append(_sq(new_state[key]))
+            out = list(new_params)
+            for key in state_keys:
+                out.extend(new_state[key])
+            return tuple(out) + (n_total,) + tuple(sq)
+
+        in_specs = (
+            (P(CLIENT_AXIS),)
+            + tuple(P(CLIENT_AXIS) for _ in range(n_leaves))
+            + tuple(shard_spec for _ in range((1 + n_state) * n_leaves))
+            + (P(), P(), P())
+        )
+        out_specs = tuple(
+            shard_spec for _ in range((1 + n_state) * n_leaves)
+        ) + tuple(P() for _ in range(3 + n_state))
+        mapped = _full_shard_map(local, mesh, in_specs=in_specs, out_specs=out_specs)
+        return jax.jit(mapped)
+
     def current_epoch(self) -> int:
         """Abandon-epoch token for ``run_round(epoch=...)``. Capture it on
         the CALLER thread before dispatching the stage worker: if the
@@ -712,14 +950,18 @@ class DeviceAggregationPlane:
         scalar fetches below synchronize). ``epoch``: abandon-epoch token
         from :meth:`current_epoch` when running on a deadline-abandonable
         worker; defaults to the current epoch (inline callers)."""
-        if len(stacked_flat) != len(self.params):
+        n_leaves = len(self._sizes)
+        if len(stacked_flat) != n_leaves:
             raise ValueError(
                 f"stacked payload has {len(stacked_flat)} arrays, plane holds "
-                f"{len(self.params)} (momenta mismatch? the server extends "
+                f"{n_leaves} (momenta mismatch? the server extends "
                 "initial params with zero momenta when aggregate_momenta is on)"
             )
         if self._program is None:
-            self._program = self._build_program(len(self.params))
+            self._program = (
+                self._build_sharded_program(n_leaves)
+                if self.sharded else self._build_program(n_leaves)
+            )
         if epoch is None:
             epoch = self.current_epoch()
         t_next = self.t + 1 if self.adaptive else self.t
@@ -728,16 +970,36 @@ class DeviceAggregationPlane:
             b2t = 1.0 - self.hyper["beta_2"] ** t_next
         else:
             b1t = b2t = 1.0
-        state_in = {k: tuple(v) for k, v in self.state.items()}
-        new_params, new_state, n_total, sq = self._program(
-            n_samples,
-            tuple(stacked_flat),
-            tuple(self.params),
-            state_in,
-            jnp.float32(lr),
-            jnp.float32(b1t),
-            jnp.float32(b2t),
-        )
+        if self.sharded:
+            n_state = len(self.state_keys)
+            state_flat = tuple(
+                t for key in self.state_keys for t in self.state[key]
+            )
+            out = self._program(
+                n_samples, *stacked_flat, *self.params, *state_flat,
+                jnp.float32(lr), jnp.float32(b1t), jnp.float32(b2t),
+            )
+            new_params = out[:n_leaves]
+            new_state = {
+                key: list(out[(1 + j) * n_leaves : (2 + j) * n_leaves])
+                for j, key in enumerate(self.state_keys)
+            }
+            n_total = out[(1 + n_state) * n_leaves]
+            sq_flat = out[(1 + n_state) * n_leaves + 1 :]
+            sq = {"pseudo_grad": sq_flat[0], "param": sq_flat[1]}
+            for j, key in enumerate(self.state_keys):
+                sq[key] = sq_flat[2 + j]
+        else:
+            state_in = {k: tuple(v) for k, v in self.state.items()}
+            new_params, new_state, n_total, sq = self._program(
+                n_samples,
+                tuple(stacked_flat),
+                tuple(self.params),
+                state_in,
+                jnp.float32(lr),
+                jnp.float32(b1t),
+                jnp.float32(b2t),
+            )
         from photon_tpu.utils.profiling import (
             EFFECTIVE_LR,
             N_CLIENTS,
@@ -800,11 +1062,52 @@ class DeviceAggregationPlane:
             self.t = t
 
     # -- host bridges ------------------------------------------------------
+    def _gather_host(self, arrays: list) -> list[np.ndarray]:
+        """Sharded padded-flat device arrays → full host leaves: the cached
+        ICI all-gather program reassembles, then the padding drops and the
+        original shapes return. Value-preserving by construction — this is
+        what makes checkpoints bit-exact across a resharding."""
+        if not arrays:
+            return []
+        if self._has_replica:
+            arrays = _gather_program(self.mesh, len(arrays))(*arrays)
+        return [
+            np.asarray(a)[: self._sizes[i]].reshape(self._shapes[i])
+            for i, a in enumerate(arrays)
+        ]
+
     def params_host(self) -> list[np.ndarray]:
-        return [np.asarray(p) for p in self.params]
+        if not self.sharded:
+            return [np.asarray(p) for p in self.params]
+        # THE all-gather of the round (ISSUE 14): updated params reassemble
+        # here, after the update — timed for server/opt_allgather_time
+        t0 = time.perf_counter()
+        params = self.params
+        out = self._gather_host(list(params))
+        self.last_allgather_s = time.perf_counter() - t0
+        return out
 
     def state_host(self) -> dict[str, list[np.ndarray]]:
-        return {k: [np.asarray(a) for a in v] for k, v in self.state.items()}
+        if not self.sharded:
+            return {k: [np.asarray(a) for a in v] for k, v in self.state.items()}
+        return {k: self._gather_host(list(v)) for k, v in self.state.items()}
+
+    def server_state_bytes_per_rank(self) -> int:
+        """Persistent server-state bytes ONE ICI rank holds between rounds
+        (params + every optimizer-state tensor, fp32): each leaf counts its
+        per-rank chunk on the sharded plane, its full size replicated. The
+        ``bench.py --zero1`` gate pins sharded ≤ (1/replica + ε) ×
+        replicated."""
+        per_leaf = self._chunks if self.sharded else self._sizes
+        return 4 * sum(per_leaf) * (1 + len(self.state_keys))
+
+    def shard_fraction(self) -> float:
+        """Per-rank fraction of the full server state this plane keeps
+        resident (``server/opt_shard_frac``): 1.0 replicated, ≈1/replica
+        sharded (chunk padding makes it marginally larger)."""
+        return sum(self._chunks if self.sharded else self._sizes) / max(
+            sum(self._sizes), 1
+        )
 
     def sync_strategy(self, strategy: Any) -> None:
         """Mirror the device-resident round results back into the host
@@ -832,7 +1135,7 @@ class DeviceAggregationPlane:
         """Modeled cross-slice DCN bytes for one round over this plane's
         payload structure (see :func:`modeled_cross_slice_bytes`)."""
         return modeled_cross_slice_bytes(
-            [int(np.prod(p.shape, dtype=np.int64)) for p in self.params],
+            list(self._sizes),
             self.n_clients,
             replica=mesh_replica(self.mesh),
             quantization=self.quantization,

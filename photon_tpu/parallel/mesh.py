@@ -19,6 +19,8 @@ Axes (SURVEY.md §2.3 mapping):
 
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 import jax
 from jax.sharding import Mesh
@@ -29,9 +31,29 @@ AXES = ("data", "fsdp", "tensor", "sequence", "pipe", "expert")
 
 
 def make_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
+    """Mesh over ``devices[:cfg.size]``. A surplus that is NOT a whole
+    multiple of the mesh size used to truncate silently — which hides a
+    mis-sized mesh config wasting chips (ISSUE 14 satellite). The
+    ``mesh.surplus_devices`` knob now gates the response: ``"warn"``
+    (default), ``"error"``, or ``"ignore"``. An exact multiple stays
+    silent: several same-size gangs carved from one device list is a
+    deliberate layout (e.g. per-client slices of a shared host)."""
     devices = devices if devices is not None else jax.devices()
     if cfg.size > len(devices):
         raise ValueError(f"mesh needs {cfg.size} devices, have {len(devices)}")
+    surplus = len(devices) % cfg.size
+    if surplus:
+        policy = getattr(cfg, "surplus_devices", "warn")
+        msg = (
+            f"mesh of size {cfg.size} truncates a {len(devices)}-device list "
+            f"that is not a whole multiple ({surplus} device(s) would idle) — "
+            "likely a mis-sized mesh config (set mesh.surplus_devices='ignore' "
+            "if intentional)"
+        )
+        if policy == "error":
+            raise ValueError(msg)
+        if policy != "ignore":
+            warnings.warn(msg, stacklevel=2)
     devs = np.asarray(devices[: cfg.size]).reshape(
         cfg.data, cfg.fsdp, cfg.tensor, cfg.sequence, cfg.pipe, cfg.expert
     )

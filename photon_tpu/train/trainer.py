@@ -15,6 +15,7 @@ reference's FSDP gather/scatter at round boundaries (``utils.py:247-319``).
 
 from __future__ import annotations
 
+import dataclasses
 import time
 import warnings
 from typing import Any, Callable, Iterable, Iterator
@@ -77,7 +78,39 @@ class Trainer:
         # cfg.model stays the operator's config of record
         from photon_tpu.config.schema import effective_model_config
 
-        self.model = MPTModel(effective_model_config(cfg.model, cfg.mesh))
+        # heterogeneity-aware layout auto-tune (ISSUE 14b): a trainer built
+        # WITHOUT an explicit mesh derives (data, fsdp, tensor, pipe) from
+        # the analytic cost model over its local device slice — the
+        # per-client entry point that replaces hand-set mesh knobs on
+        # uneven fleets. An explicit ``mesh=`` always wins (callers that
+        # pin devices, e.g. the collective runner, keep full control).
+        mesh_cfg = cfg.mesh
+        self.layout_autotune: dict | None = None
+        if mesh is None and cfg.photon.mesh_autotune:
+            from photon_tpu.parallel.autotune import autotune_layout
+
+            t0 = time.monotonic()
+            micro = cfg.train.device_microbatch_size
+            best = autotune_layout(
+                cfg.model, devices=jax.local_devices(),
+                global_batch_size=cfg.train.global_batch_size,
+                microbatch=micro if isinstance(micro, int) else 0,
+                # 'auto' microbatch probes against the NON-pipelined step
+                # (the combination Config.validate rejects) — never let
+                # the tuner pick a pipelined layout the probe can't build
+                max_pipe=None if isinstance(micro, int) else 1,
+            )
+            mesh_cfg = dataclasses.replace(
+                best.mesh, surplus_devices=cfg.mesh.surplus_devices
+            )
+            self.layout_autotune = {
+                "mesh": mesh_cfg,
+                "search_s": time.monotonic() - t0,
+                "est_step_s": best.est_step_s,
+            }
+            mesh = make_mesh(mesh_cfg, devices=jax.local_devices())
+
+        self.model = MPTModel(effective_model_config(cfg.model, mesh_cfg))
         self.tx, self.lr_schedule = build_optimizer(cfg.optimizer, cfg.scheduler)
         self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
 

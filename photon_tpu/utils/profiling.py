@@ -98,6 +98,23 @@ COLLECTIVE_DEGRADED_ROUNDS = "server/collective_degraded_rounds"
 #: first attempt lands
 COLLECTIVE_RECONFIG_TIME = "server/collective_reconfig_time"
 
+# -- ZeRO-1 sharded server update + layout auto-tuner (ISSUE 14) ----------
+#: per-rank fraction of the full server state (params + optimizer moments)
+#: resident on the device plane: 1.0 replicated, ≈1/replica on the ZeRO-1
+#: sharded plane (chunk padding makes it marginally larger)
+OPT_SHARD_FRAC = "server/opt_shard_frac"
+#: wall seconds of the post-update params ICI all-gather + host fetch (the
+#: ONE all-gather of a sharded round — it runs after the update, inside
+#: the update leg; 0.0 on the replicated plane, where params never shard)
+OPT_ALLGATHER_TIME = "server/opt_allgather_time"
+#: wall seconds the layout auto-tuner (parallel/autotune.py) spent
+#: enumerating + ranking (data, fsdp, tensor, pipe) meshes for this
+#: client's device slice
+LAYOUT_SEARCH_TIME = "server/layout_search_time"
+#: the auto-tuner's analytic step-time estimate for the layout it picked
+#: (compare against the measured step time to audit the cost model)
+LAYOUT_EST_STEP_S = "server/layout_est_step_s"
+
 # -- wire / compression plane (WireStats.metrics_since) -------------------
 WIRE_UPLINK_RAW_BYTES = "server/wire_uplink_raw_bytes"
 WIRE_UPLINK_BYTES = "server/wire_uplink_bytes"

@@ -482,6 +482,238 @@ def test_fedadam_checkpoint_resume_bias_correction_continuity():
 
 
 # ---------------------------------------------------------------------------
+# ZeRO-1 sharded plane (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def _round_clients(rnd, n_clients=N_CLIENTS, shapes=SHAPES):
+    r = np.random.default_rng(1000 + rnd)
+    clients = [
+        [r.normal(size=s).astype(np.float32) for s in shapes]
+        for _ in range(n_clients)
+    ]
+    return clients, r.integers(1, 30, n_clients).astype(np.int32)
+
+
+@pytest.mark.parametrize("name", sorted(STRATEGIES))
+@pytest.mark.parametrize("quantization", ["off", "q8"])
+def test_sharded_plane_bit_exact_vs_replicated(name, quantization):
+    """Acceptance (ISSUE 14): the ZeRO-1 sharded round — update on each
+    rank's reduce-scatter chunk, all-gather only after the update — is
+    BIT-IDENTICAL to the replicated PR 7 plane for all five strategies at
+    off AND q8 (the update is elementwise, the padded-flat layout is
+    value-preserving, and the q8 block boundaries stay globally aligned).
+    Since the replicated plane is pinned against the host
+    ``aggregate_inplace`` + ``apply_average`` oracle, the sharded plane
+    inherits that oracle parity transitively."""
+    rng = np.random.default_rng(7)
+    init = [rng.normal(size=s).astype(np.float32) for s in SHAPES]
+    mesh = make_hierarchical_mesh(N_CLIENTS, 2)
+
+    def make_plane(sharded):
+        strat = _strategy(name)
+        strat.initialize([p.copy() for p in init])
+        return DeviceAggregationPlane(
+            mesh, strat, quantization=quantization, block=16, sharded=sharded
+        )
+
+    plane_s, plane_r = make_plane(True), make_plane(False)
+    assert plane_s.sharded and not plane_r.sharded
+    assert plane_s.shard_fraction() < 1.0 <= plane_r.shard_fraction()
+    assert (plane_s.server_state_bytes_per_rank()
+            < plane_r.server_state_bytes_per_rank())
+    for rnd in range(1, 4):
+        clients, counts = _round_clients(rnd)
+        ms = plane_s.run_round(
+            _stacked_flat(clients, mesh), _ns_global(counts, mesh), lr=0.5
+        )
+        mr = plane_r.run_round(
+            _stacked_flat(clients, mesh), _ns_global(counts, mesh), lr=0.5
+        )
+        assert ms["server/n_samples"] == mr["server/n_samples"]
+        # norm KPIs agree to fp32 (sharded sums partial-then-psum)
+        np.testing.assert_allclose(
+            ms["server/pseudo_grad_norm"], mr["server/pseudo_grad_norm"],
+            rtol=1e-4,
+        )
+    for a, b in zip(plane_s.params_host(), plane_r.params_host()):
+        np.testing.assert_array_equal(a, b)
+    for key in plane_s.state_keys:
+        for a, b in zip(plane_s.state_host()[key], plane_r.state_host()[key]):
+            np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("quantization", ["off", "q8"])
+@pytest.mark.parametrize("save_replica,resume_replica", [(4, 1), (1, 4)])
+def test_sharded_checkpoint_bit_exact_across_resharding(
+    quantization, save_replica, resume_replica
+):
+    """Acceptance (ISSUE 14): save at replica=4, resume at replica=1 (and
+    vice versa) continues BIT-identically — including FedAdam ``_t``
+    continuity — because ``state_for_checkpoint`` stores full unpadded
+    leaves and re-seeding re-slices them; at q8 the block boundaries stay
+    aligned to the global padded vector for every replica, so even the
+    quantized average is replica-invariant."""
+    n_clients = 2
+    rng = np.random.default_rng(3)
+    init = [rng.normal(size=s).astype(np.float32) for s in SHAPES]
+
+    def make_plane(replica, params, state=None):
+        strat = _strategy("fedadam")
+        strat.initialize(params, state)
+        mesh = make_hierarchical_mesh(n_clients, replica)
+        return strat, DeviceAggregationPlane(
+            mesh, strat, quantization=quantization, block=16, sharded=True
+        ), mesh
+
+    def run(plane, mesh, rnd):
+        clients, counts = _round_clients(rnd, n_clients)
+        plane.run_round(
+            _stacked_flat(clients, mesh), _ns_global(counts, mesh), lr=0.5
+        )
+
+    # continuous: 3 rounds at the SAVE replica count
+    strat_c, plane_c, mesh_c = make_plane(
+        save_replica, [p.copy() for p in init]
+    )
+    for rnd in range(1, 4):
+        run(plane_c, mesh_c, rnd)
+
+    # interrupted: 2 rounds → checkpoint → resume at the OTHER replica
+    strat_a, plane_a, mesh_a = make_plane(
+        save_replica, [p.copy() for p in init]
+    )
+    for rnd in range(1, 3):
+        run(plane_a, mesh_a, rnd)
+    plane_a.sync_strategy(strat_a)
+    assert strat_a._t == 2
+    ckpt_state = strat_a.state_for_checkpoint()
+    ckpt_params = [p.copy() for p in strat_a.current_parameters]
+
+    strat_b, plane_b, mesh_b = make_plane(
+        resume_replica, ckpt_params, ckpt_state
+    )
+    assert plane_b.t == 2  # bias correction continues across the reshard
+    run(plane_b, mesh_b, 3)
+
+    # round 3 after a resharded resume == round 3 continuous, bitwise.
+    # NOTE this also pins the round itself replica-invariant (the psum
+    # order and q8 block alignment arguments) — strictly stronger than
+    # the save/load identity alone
+    for a, b in zip(plane_c.params_host(), plane_b.params_host()):
+        np.testing.assert_array_equal(a, b)
+    for key in ("momentum_1", "momentum_2"):
+        for a, b in zip(plane_c.state_host()[key], plane_b.state_host()[key]):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_sharded_seeding_peak_host_rss_bounded():
+    """ISSUE 14 satellite: ``_seed_from_host`` seeds every leaf DIRECTLY
+    into its sharded layout — no full fp32 host copy per leaf, and missing
+    state keys zero-fill chunk-by-chunk instead of materializing whole
+    zero arrays. Peak traced host allocation during construction must stay
+    near ONE chunk (payload/replica), far below the payload itself; the
+    old path held full zero copies of every missing state tensor at once
+    (2 × payload for FedAdam)."""
+    import tracemalloc
+
+    replica = 4
+    leaf = np.zeros((512, 2048), np.float32)  # 4 MiB
+    payload_bytes = leaf.nbytes
+    chunk_bytes = payload_bytes // replica
+
+    def construction_peak(sharded):
+        strat = _strategy("fedadam")
+        strat.initialize([leaf.copy()])  # m1/m2 zero-filled by the plane
+        strat.state.clear()  # initialize() pre-fills; force the plane path
+        mesh = make_hierarchical_mesh(2, replica)
+        tracemalloc.start()
+        plane = DeviceAggregationPlane(mesh, strat, sharded=sharded)
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        # the zero-filled state actually landed either way
+        assert float(np.abs(plane.state_host()["momentum_1"][0]).max()) == 0.0
+        return peak
+
+    sharded_peak = construction_peak(True)
+    replicated_peak = construction_peak(False)
+    # replicated seeding materializes full zero tensors per missing state
+    # key (2 × 4 MiB here); sharded seeding allocates ~one chunk (params
+    # seed as views, zero shards alias one shared chunk buffer)
+    assert sharded_peak < replicated_peak, (
+        f"sharded seeding peaked at {sharded_peak / 2**20:.1f} MiB, "
+        f"replicated at {replicated_peak / 2**20:.1f} MiB"
+    )
+    assert sharded_peak < 2 * chunk_bytes, (
+        f"sharded seeding allocated {sharded_peak / 2**20:.1f} MiB on host "
+        f"for a {payload_bytes / 2**20:.1f} MiB payload at replica={replica} "
+        f"(expected ~one {chunk_bytes / 2**20:.1f} MiB chunk)"
+    )
+
+
+def test_sharded_update_leg_no_steady_state_compiles():
+    """The sharded round's FULL update leg (fused program + post-update
+    params all-gather + state mirror gather) reuses cached programs from
+    round 2 on — the PR 6 retrace sentinel discipline extends to the new
+    gather programs."""
+    from photon_tpu.analysis.runtime import retrace_guard
+
+    rng = np.random.default_rng(41)
+    mesh = make_hierarchical_mesh(N_CLIENTS, 2)
+    strat = _strategy("fedadam")
+    strat.initialize([rng.normal(size=s).astype(np.float32) for s in SHAPES])
+    plane = DeviceAggregationPlane(mesh, strat, sharded=True)
+
+    def one_round(rnd):
+        clients, counts = _round_clients(rnd)
+        plane.run_round(
+            _stacked_flat(clients, mesh), _ns_global(counts, mesh), lr=0.5
+        )
+        plane.params_host()
+        plane.state_host()
+
+    one_round(1)  # warmup: fused program + gather programs compile once
+    with retrace_guard(steady=True):
+        one_round(2)
+        one_round(3)
+    assert plane.last_allgather_s > 0.0
+
+
+def test_sharded_snapshot_restore_and_abandon_epoch():
+    """PR 8 elastic semantics hold shard-aware: snapshot → run → restore
+    rolls the sharded plane back bit-exactly, and an abandoned epoch's
+    late commit is skipped (the commit path never mixes layouts)."""
+    rng = np.random.default_rng(55)
+    mesh = make_hierarchical_mesh(N_CLIENTS, 2)
+    strat = _strategy("fedadam")
+    strat.initialize([rng.normal(size=s).astype(np.float32) for s in SHAPES])
+    plane = DeviceAggregationPlane(mesh, strat, sharded=True)
+    clients, counts = _round_clients(1)
+    plane.run_round(_stacked_flat(clients, mesh), _ns_global(counts, mesh), lr=0.5)
+    before = plane.params_host()
+    snap = plane.snapshot()
+
+    clients2, counts2 = _round_clients(2)
+    plane.run_round(_stacked_flat(clients2, mesh), _ns_global(counts2, mesh), lr=0.5)
+    assert plane.t == 2
+    plane.abandon()
+    plane.restore(snap)
+    assert plane.t == 1
+    for a, b in zip(before, plane.params_host()):
+        np.testing.assert_array_equal(a, b)
+
+    # a run dispatched under the pre-abandon epoch must not commit
+    stale_epoch = 0  # current_epoch() was 0 before abandon bumped it
+    plane.run_round(
+        _stacked_flat(clients2, mesh), _ns_global(counts2, mesh), lr=0.5,
+        epoch=stale_epoch,
+    )
+    assert plane.t == 1  # skipped: the round completed another way
+    for a, b in zip(before, plane.params_host()):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
 # steady-state compile discipline (programs cached, not rebuilt per round)
 # ---------------------------------------------------------------------------
 

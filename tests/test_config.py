@@ -56,6 +56,7 @@ def test_collective_knobs_require_collective_stack():
         ("collective_replica", 2),
         ("collective_q8_block", 64),
         ("collective_device_optimizer", True),
+        ("collective_zero1", False),
     ):
         cfg = Config()
         assert not cfg.photon.comm_stack.collective
@@ -66,7 +67,18 @@ def test_collective_knobs_require_collective_stack():
     cfg.photon.comm_stack.collective = True
     cfg.photon.comm_stack.shm = False
     cfg.photon.comm_stack.collective_q8_block = 64
+    cfg.photon.comm_stack.collective_zero1 = False  # legal WITH collective
     cfg.validate()
+
+
+def test_mesh_surplus_devices_validated():
+    cfg = Config()
+    cfg.mesh.surplus_devices = "explode"
+    with pytest.raises(ValueError, match="surplus_devices"):
+        cfg.validate()
+    for ok in ("warn", "error", "ignore"):
+        cfg.mesh.surplus_devices = ok
+        cfg.validate()
 
 
 def test_json_roundtrip():

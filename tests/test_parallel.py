@@ -38,6 +38,35 @@ def test_mesh_axes_and_size():
         make_mesh(MeshConfig(data=16))
 
 
+def test_mesh_surplus_devices_knob():
+    """ISSUE 14 satellite: ``devices[:size]`` used to truncate silently;
+    a surplus that is NOT a whole multiple of the mesh size now warns by
+    default, raises under ``surplus_devices='error'``, and stays silent
+    for exact multiples (several same-size gangs from one list is a
+    deliberate layout) or under 'ignore'."""
+    import warnings
+
+    devices = jax.devices()  # 8 virtual CPU devices
+
+    # 8 % 3 != 0: warn by default, mentioning the idle count
+    with pytest.warns(UserWarning, match="2 device"):
+        mesh = make_mesh(MeshConfig(data=3), devices=devices)
+    assert mesh.devices.size == 3
+
+    with pytest.raises(ValueError, match="not a whole multiple"):
+        make_mesh(MeshConfig(data=3, surplus_devices="error"),
+                  devices=devices)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        # 'ignore' restores the old silence
+        make_mesh(MeshConfig(data=3, surplus_devices="ignore"),
+                  devices=devices)
+        # exact multiples never warn (8 % 4 == 0, 8 % 8 == 0)
+        make_mesh(MeshConfig(data=4), devices=devices)
+        make_mesh(MeshConfig(data=8), devices=devices)
+
+
 def test_param_specs_rules():
     mesh = make_mesh(MeshConfig(fsdp=4, tensor=2))
     params = init_params(TINY, seed=0)

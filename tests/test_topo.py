@@ -1,0 +1,84 @@
+"""``parallel/topo.abstract_tpu_devices`` error paths (ISSUE 14
+satellite): the happy path is exercised indirectly by the AOT compile
+checks, but the failure modes — malformed topology strings, a raising
+``get_topology_desc`` — must degrade cleanly AND restore every env var the
+helper overrode (a leaked TPU_* var would poison later backend inits in
+the same process)."""
+
+import os
+
+import pytest
+
+from photon_tpu.parallel import topo
+
+_ENV_KEYS = ("TPU_SKIP_MDS_QUERY", "TPU_ACCELERATOR_TYPE",
+             "TPU_WORKER_HOSTNAMES", "TPU_TOPOLOGY")
+
+
+def _env_snapshot():
+    return {k: os.environ.get(k) for k in _ENV_KEYS}
+
+
+def test_malformed_topology_string_rejected():
+    with pytest.raises(ValueError, match="must look like"):
+        topo.abstract_tpu_devices("v5e-2x2x1")  # no colon
+    with pytest.raises(ValueError, match="must look like"):
+        topo.abstract_tpu_devices("2x2x1")
+
+
+def test_malformed_string_leaves_env_untouched():
+    before = _env_snapshot()
+    with pytest.raises(ValueError):
+        topo.abstract_tpu_devices("garbage")
+    assert _env_snapshot() == before
+
+
+def test_env_restored_after_raising_get_topology_desc(monkeypatch):
+    """A get_topology_desc that raises (libtpu missing/incompatible) must
+    surface as the documented RuntimeError AND restore the env overrides
+    in the finally block — including a pre-existing value the helper
+    overwrote."""
+    from jax.experimental import topologies
+
+    monkeypatch.setenv("TPU_TOPOLOGY", "preexisting-sentinel")
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    before = _env_snapshot()
+
+    seen_env = {}
+
+    def boom(*a, **kw):
+        seen_env.update(_env_snapshot())
+        raise OSError("libtpu exploded")
+
+    monkeypatch.setattr(topologies, "get_topology_desc", boom)
+    with pytest.raises(RuntimeError, match="unavailable") as ei:
+        topo.abstract_tpu_devices("v5e:2x2x1")
+    # the cause is chained for debuggability
+    assert isinstance(ei.value.__cause__, OSError)
+    # the overrides WERE in place during the call...
+    assert seen_env["TPU_TOPOLOGY"] == "2x2"
+    assert seen_env["TPU_WORKER_HOSTNAMES"] == "localhost"
+    # ...and are fully restored after: overwritten values come back,
+    # helper-created keys are removed again
+    assert _env_snapshot() == before
+    assert os.environ["TPU_TOPOLOGY"] == "preexisting-sentinel"
+    assert "TPU_WORKER_HOSTNAMES" not in os.environ
+
+
+def test_v5e_trailing_x1_sugar_stripped_exactly_once(monkeypatch):
+    """"2x4x1" == "2x4" for the 2-D v5e generation — but only a literal
+    trailing x1 dimension is stripped, never a substring."""
+    from jax.experimental import topologies
+
+    seen = []
+
+    def record(*a, **kw):
+        seen.append(os.environ.get("TPU_TOPOLOGY"))
+        raise OSError("stop here")
+
+    monkeypatch.setattr(topologies, "get_topology_desc", record)
+    for spec, expect in [("v5e:2x4x1", "2x4"), ("v5e:2x1", "2x1"),
+                         ("v5e:1x1", "1x1")]:
+        with pytest.raises(RuntimeError):
+            topo.abstract_tpu_devices(spec)
+        assert seen[-1] == expect, spec
