@@ -1,7 +1,7 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test test-all bench bench-host bench-telemetry bench-collective bench-zero1 bench-ragged bench-compare chaos chaos-collective telemetry-smoke serve-smoke adapters-smoke lint lint-tests native clean
+.PHONY: test test-all bench bench-host bench-telemetry bench-collective bench-zero1 bench-ragged bench-compare chaos chaos-collective telemetry-smoke serve-smoke spec-smoke adapters-smoke lint lint-tests native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
@@ -103,6 +103,21 @@ serve-smoke: lint
 		tests/test_serve.py tests/test_serve_prefix.py tests/test_hotswap.py \
 		tests/test_ragged_attention.py -q -m "slow or not slow"
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --serving
+
+# speculative decoding (ISSUE 15): the draft-and-verify suite — the
+# generalized grid's bitwise parity with K sequential single-token steps
+# (incl. a mid-prefill batch-mate), greedy end-to-end bit-exactness
+# through the batcher (prefix hits, recycled blocks, EOS mid-burst),
+# rejection-sampling distribution pins, the n-gram drafter + accept-rate
+# throttle, and the retrace sentinel over warm speculative bursts with
+# the full-idle high-water reset — then the bench gate: speculative must
+# beat plain decode on templated traffic AND not regress on random
+# traffic with drafting auto-throttled off. Rides tier-1 too (none is
+# slow); lint preflight first like the other smoke targets.
+spec-smoke: lint
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_speculative.py -q -m "slow or not slow"
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --speculative
 
 # per-cohort LoRA personalization plane (ISSUE 13): the train-side suite
 # (config validation, LoRA payload algebra, fused multi-cohort reduction

@@ -1978,6 +1978,141 @@ def ragged_serving_report(occupancies=(0.1, 0.5, 0.9), n_slots: int = 4,
         return None
 
 
+def speculative_serving_report(n_requests: int = 4, n_slots: int = 4,
+                               seed: int = 0) -> dict | None:
+    """Self-drafted speculative decoding vs plain decode (ISSUE 15):
+    tokens/s + accept rate on TEMPLATED vs RANDOM traffic.
+
+    **Templated traffic**: greedy, decode-heavy requests (patterned
+    prompts, long max_new). Tiny models' greedy streams collapse into
+    short cycles and patterned prompts repeat — exactly the
+    latest-occurrence structure n-gram / prompt-lookup drafting predicts,
+    so most drafts verify and each step emits several tokens. Greedy
+    speculative output is bit-exact with the baseline (asserted per
+    request), so the speedup is pure scheduling, not different text.
+
+    **Random traffic**: temperature-1.0 seeded sampling — incompressible
+    streams whose next token almost never matches an n-gram guess. The
+    accept-rate EWMA must throttle drafting off (plain decode), so
+    tokens/s may not regress beyond scheduler noise.
+
+    Both modes share ONE engine (the compiled step cache too); only the
+    batcher's drafting differs. ABBA-ordered best-of per (traffic, mode).
+    Exit gates (bench.py --speculative / make spec-smoke): speculative >
+    baseline on templated AND speculative >= 0.9x baseline on random
+    with drafting genuinely throttled off."""
+    try:
+        import numpy as np
+
+        from photon_tpu.config.schema import Config
+        from photon_tpu.models.mpt import init_params
+        from photon_tpu.serve.engine import PagedEngine
+        from photon_tpu.serve.scheduler import ContinuousBatcher
+        from photon_tpu.utils.profiling import (
+            SERVE_SPEC_ACCEPT_RATE,
+            SERVE_SPEC_ACCEPTED,
+            SERVE_SPEC_DRAFTED,
+            SERVE_SPEC_K,
+        )
+
+        cfg = Config()
+        cfg.model.d_model = 32
+        cfg.model.n_layers = 2
+        cfg.model.n_heads = 2
+        cfg.model.max_seq_len = 128
+        cfg.model.vocab_size = 64
+        cfg.model.attn_impl = "xla"
+        cfg.model.compute_dtype = "float32"
+        cfg.photon.serve.n_slots = n_slots
+        cfg.photon.serve.block_size = 8
+        cfg.photon.serve.max_new_tokens = 64
+        sp = cfg.photon.serve.speculative
+        sp.enabled = True
+        cfg.validate()
+        engine = PagedEngine(cfg, init_params(cfg.model, seed=4))
+        rng = np.random.default_rng(seed)
+
+        # templated: patterned prompts + long greedy decode (the cycle
+        # regime); random: fresh prompts + temperature-1 sampled streams
+        base = list(map(int, rng.integers(1, 64, 6)))
+        templated = [(base * 2 + list(map(int, rng.integers(1, 64, 3))),
+                      48, 0.0) for _ in range(n_requests)]
+        random_traffic = [
+            (list(map(int, rng.integers(1, 64, 12))), 48, 1.0)
+            for _ in range(n_requests)
+        ]
+
+        def run_mode(speculative: bool, requests) -> dict:
+            batcher = ContinuousBatcher(
+                engine, max_queue=n_requests + 1,
+                speculative=sp if speculative else None,
+            ).start()
+            try:
+                t0 = time.perf_counter()
+                reqs = [batcher.submit(p, n, temperature=t, seed=i)
+                        for i, (p, n, t) in enumerate(requests)]
+                outs = [r.result(timeout=600) for r in reqs]
+                wall = time.perf_counter() - t0
+                stats = batcher.stats()
+            finally:
+                batcher.close()
+            tokens = sum(len(o) for o in outs)
+            out = {
+                "tokens": tokens,
+                "tokens_per_s": round(tokens / wall, 2),
+                "wall_s": round(wall, 4),
+                "completions": outs,
+            }
+            if speculative:
+                drafted = stats.get(SERVE_SPEC_DRAFTED, 0.0)
+                accepted = stats.get(SERVE_SPEC_ACCEPTED, 0.0)
+                out["drafted"] = int(drafted)
+                out["accepted"] = int(accepted)
+                out["accept_rate"] = (
+                    round(accepted / drafted, 4) if drafted else None
+                )
+                out["accept_ewma"] = stats.get(SERVE_SPEC_ACCEPT_RATE)
+                out["spec_k_final"] = stats.get(SERVE_SPEC_K)
+            return out
+
+        # warmup OUTSIDE the clock: both traffic shapes once, so every
+        # (chunk, verify, live-width) bucket is compiled before timing
+        run_mode(True, templated)
+        run_mode(False, templated)
+        run_mode(True, random_traffic)
+
+        out: dict = {"n_slots": n_slots, "k": sp.k}
+        for label, requests in (("templated", templated),
+                                ("random", random_traffic)):
+            runs = {"speculative": [], "baseline": []}
+            for spec_on in (True, False, False, True, True, False):
+                runs["speculative" if spec_on else "baseline"].append(
+                    run_mode(spec_on, requests)
+                )
+            best = {m: min(rs, key=lambda r: r["wall_s"])
+                    for m, rs in runs.items()}
+            if label == "templated":
+                # greedy both modes: the speedup must be pure scheduling
+                assert (best["speculative"]["completions"]
+                        == best["baseline"]["completions"]), (
+                    "speculative greedy output diverged from baseline"
+                )
+            for b in best.values():
+                b.pop("completions", None)
+            best["speedup"] = (
+                round(best["speculative"]["tokens_per_s"]
+                      / best["baseline"]["tokens_per_s"], 3)
+                if best["baseline"]["tokens_per_s"] else None
+            )
+            out[label] = best
+        out["templated_speedup"] = out["templated"]["speedup"]
+        out["random_speedup"] = out["random"]["speedup"]
+        return out
+    except Exception as e:  # noqa: BLE001 — never cost the round its numbers
+        log(f"speculative serving report failed: {type(e).__name__}: {e}")
+        return None
+
+
 # ---------------------------------------------------------------------------
 # Device-collective aggregation plane (ISSUE 7; lands in the BENCH_*.json)
 # ---------------------------------------------------------------------------
@@ -2598,12 +2733,21 @@ def _ragged_low_occ_tps(parsed: dict) -> float | None:
     return _dig(occ, (k, "ragged", "tokens_per_s"))
 
 
+def _spec_templated_tps(parsed: dict) -> float | None:
+    """Speculative tokens/s on templated traffic (the regime self-drafted
+    verification exists for, ISSUE 15)."""
+    return _dig(parsed, ("serving_speculative", "templated", "speculative",
+                         "tokens_per_s"))
+
+
 #: gated headline numbers, (extractor, label, platform_sensitive). Higher
 #: is better for all; a drop past the threshold exits nonzero.
 _COMPARE_GATES = (
     (lambda p: _dig(p, ("value",)), "train_tokens_per_sec", True),
     (_serving_tps, "serving_tokens_per_s", False),
     (_ragged_low_occ_tps, "serving_ragged_low_occ_tokens_per_s", False),
+    (_spec_templated_tps, "serving_speculative_templated_tokens_per_s",
+     False),
     # fused-grouped-reduction win over K sequential reductions (ISSUE 13)
     (lambda p: _dig(p, ("adapters", "fused_speedup")),
      "adapters_fused_speedup", False),
@@ -3081,6 +3225,13 @@ def run(platform: str) -> None:
         if rg is not None:
             out["serving_ragged"] = rg
             emit(out)
+        # speculative decoding (ISSUE 15): tokens/s + accept rate on
+        # templated vs random traffic, drafting auto-throttled off on the
+        # latter
+        sd = speculative_serving_report()
+        if sd is not None:
+            out["serving_speculative"] = sd
+            emit(out)
 
     # device-collective aggregation plane (own child interpreter — the
     # emulated 8-device CPU mesh must exist before jax initializes): flat
@@ -3248,6 +3399,14 @@ def main() -> int:
                          "TPOT) and print {'serving_ragged': ...}; exits "
                          "nonzero unless ragged wins at low occupancy and "
                          "chunking cuts the worst decode gap")
+    ap.add_argument("--speculative", action="store_true",
+                    help="run only the speculative-decoding serving report "
+                         "(self-drafted verify vs plain decode on templated "
+                         "and random traffic, tiny CPU model) and print "
+                         "{'serving_speculative': ...}; exits nonzero "
+                         "unless speculative beats baseline on templated "
+                         "traffic AND does not regress (>= 0.9x, drafting "
+                         "auto-throttled off) on random traffic")
     ap.add_argument("--adapters", action="store_true",
                     help="per-cohort LoRA plane gate (ISSUE 13): modeled "
                          "adapter wire bytes >= 50x below a full-model "
@@ -3323,6 +3482,23 @@ def main() -> int:
         gap_ratio = ((rg or {}).get("chunked_tpot") or {}).get("gap_ratio")
         return 0 if (ragged_gain and ragged_gain > 1.0
                      and gap_ratio and gap_ratio > 1.0) else 1
+    if args.speculative:
+        # the ISSUE 15 gate alone (make spec-smoke): speculative must WIN
+        # on templated traffic (accepted drafts turn one step into
+        # several tokens) and must NOT regress on random traffic — the
+        # throttle has to have turned drafting off (spec_k 0), and the
+        # 0.9x floor absorbs 1-core scheduler noise around the resulting
+        # plain-decode parity
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sd = speculative_serving_report()
+        emit({"serving_speculative": sd})
+        if sd is None:
+            return 1
+        t_gain = sd.get("templated_speedup")
+        r_gain = sd.get("random_speedup")
+        throttled = (sd["random"]["speculative"].get("spec_k_final") == 0.0)
+        return 0 if (t_gain and t_gain > 1.0
+                     and r_gain and r_gain >= 0.9 and throttled) else 1
     if args.adapters:
         # CPU-jax only, fresh backend (the emulated client mesh must be
         # configured before jax initializes — the in-run bench reaches

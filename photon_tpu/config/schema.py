@@ -381,6 +381,50 @@ class TelemetryConfig:
 
 
 @dataclass
+class SpeculativeConfig:
+    """Self-drafted speculative decoding (ISSUE 15, ``serve/draft.py``).
+
+    OFF by default (the serve-plane opt-in discipline). Enabled, the
+    scheduler drafts up to ``k`` tokens per decoding slot per step from a
+    host-side n-gram / prompt-lookup drafter over that slot's own
+    prompt+generated history (zero extra weights), verifies ALL rows'
+    drafts in ONE mixed-grid step (the same ``(B, Tq)`` compiled program
+    shape chunked prefill already runs), and emits the longest accepted
+    prefix plus one model token. Greedy output is BIT-EXACT vs the
+    non-speculative engine; temperature rows use standard rejection
+    sampling (distribution-preserving; seeded streams stay deterministic
+    and batch-mate-independent but are NOT the non-speculative sample
+    path — see docs/serving.md).
+
+    An accept-rate EWMA auto-throttles ``k`` and falls back to plain
+    decode below ``accept_floor``, so adversarial (incompressible)
+    traffic never regresses; ``probe_ticks`` re-probes periodically so a
+    throttled-off engine can recover when traffic turns templated again.
+    """
+
+    enabled: bool = False
+    #: max draft tokens per decoding row per step (the verify grid runs
+    #: at most ``k + 1`` columns; widths bucket to pow2 so the compiled
+    #: shape set stays bounded)
+    k: int = 4
+    #: per-TICK total draft tokens across all rows, composed with
+    #: ``prefill_token_budget``: a step carrying a prompt chunk of C
+    #: tokens drafts at most ``min(draft_budget, prefill_token_budget - C)``
+    draft_budget: int = 64
+    #: n-gram match orders for the prompt-lookup drafter (longest first)
+    max_ngram: int = 3
+    min_ngram: int = 1
+    #: accept-rate EWMA floor: below it the throttle sets K=0 (plain
+    #: decode) until a periodic probe sees acceptance again
+    accept_floor: float = 0.30
+    #: EWMA smoothing weight for per-step accept rates
+    ewma_alpha: float = 0.2
+    #: while throttled off, probe with one drafted step every N ticks
+    #: (0 = never probe: once off, stays off)
+    probe_ticks: int = 64
+
+
+@dataclass
 class ServeConfig:
     """Continuous-batching inference plane (``photon_tpu/serve``).
 
@@ -452,6 +496,10 @@ class ServeConfig:
     # "failing" federation plane blocks swaps (don't track a failing run).
     # Unreachable endpoints fail open — see serve/hotswap.py.
     hotswap_statusz_url: str = ""
+    # self-drafted speculative decoding (ISSUE 15, serve/draft.py): every
+    # decoding row may carry up to k draft tokens through the mixed grid,
+    # verified in one step — greedy bit-exact, auto-throttled by accept rate
+    speculative: SpeculativeConfig = field(default_factory=SpeculativeConfig)
 
 
 #: dense-projection module names LoRA can target (the per-layer matmuls
@@ -835,6 +883,38 @@ class Config:
         if srv.hotswap_poll_s <= 0:
             raise ValueError(
                 f"serve.hotswap_poll_s must be > 0, got {srv.hotswap_poll_s}"
+            )
+        spec = srv.speculative
+        if not 1 <= spec.k <= 32:
+            raise ValueError(
+                f"serve.speculative.k must be in [1, 32], got {spec.k} "
+                "(the verify grid runs k+1 columns — a deeper draft than 32 "
+                "is past any n-gram drafter's useful horizon)"
+            )
+        if spec.draft_budget < 1:
+            raise ValueError(
+                f"serve.speculative.draft_budget must be >= 1, got "
+                f"{spec.draft_budget}"
+            )
+        if not 1 <= spec.min_ngram <= spec.max_ngram:
+            raise ValueError(
+                f"serve.speculative needs 1 <= min_ngram <= max_ngram, got "
+                f"{spec.min_ngram}/{spec.max_ngram}"
+            )
+        if not 0.0 <= spec.accept_floor <= 1.0:
+            raise ValueError(
+                f"serve.speculative.accept_floor must be in [0, 1], got "
+                f"{spec.accept_floor}"
+            )
+        if not 0.0 < spec.ewma_alpha <= 1.0:
+            raise ValueError(
+                f"serve.speculative.ewma_alpha must be in (0, 1], got "
+                f"{spec.ewma_alpha}"
+            )
+        if spec.probe_ticks < 0:
+            raise ValueError(
+                f"serve.speculative.probe_ticks must be >= 0 (0 = never "
+                f"probe), got {spec.probe_ticks}"
             )
         ad = self.photon.adapters
         if ad.enabled:

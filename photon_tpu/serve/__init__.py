@@ -39,6 +39,7 @@ for the serving plane.
 """
 
 from photon_tpu.serve.cache import BlockAllocator, PagedState, paged_decode_step
+from photon_tpu.serve.draft import Drafter, NGramDrafter, SpecController
 from photon_tpu.serve.engine import PagedEngine
 from photon_tpu.serve.frontend import ServeFrontend
 from photon_tpu.serve.hotswap import CheckpointWatcher
@@ -49,12 +50,15 @@ __all__ = [
     "BlockAllocator",
     "CheckpointWatcher",
     "ContinuousBatcher",
+    "Drafter",
+    "NGramDrafter",
     "PagedEngine",
     "PagedState",
     "PrefixCache",
     "QueueFullError",
     "ServeFrontend",
     "ServeRequest",
+    "SpecController",
     "paged_decode_step",
     "prefix_hashes",
 ]

@@ -89,6 +89,7 @@ def main(argv: list[str] | None = None) -> None:
         max_queue=sc.max_queue,
         prefill_token_budget=sc.prefill_token_budget,
         default_eos_id=sc.eos_id if sc.eos_id >= 0 else None,
+        speculative=sc.speculative,
     ).start()
     tokenizer = None
     if args.tokenizer:

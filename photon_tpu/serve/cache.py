@@ -222,7 +222,8 @@ def mixed_chunk_step(params: dict, state: PagedState, tokens: jax.Array,
                      chunk_slot: jax.Array, cfg: ModelConfig, *, n_ctx: int,
                      has_chunk: bool = False, impl: str = "gather",
                      interpret: bool = False, adapters: dict | None = None,
-                     lora_scale: float = 1.0) -> tuple[jax.Array, PagedState]:
+                     lora_scale: float = 1.0,
+                     n_spec: int = 1) -> tuple[jax.Array, PagedState]:
     """ONE serving program for a mixed chunked-prefill batch (ISSUE 12):
     every slot contributes a row of ``tokens [n_slots, Tq]`` — a decode
     row places its single last-emitted token in column 0 (rest padding),
@@ -282,6 +283,26 @@ def mixed_chunk_step(params: dict, state: PagedState, tokens: jax.Array,
     requests from different cohorts, and a trash-page row (all-zero
     factors) decodes the bare base through the same graph. None keeps the
     step byte-identical to the adapter-free build.
+
+    ``n_spec`` (ISSUE 15, speculative decoding): with ``n_spec > 1``,
+    EVERY decode row may carry up to ``n_spec`` consecutive tokens
+    (``[last_emitted, draft_1, .., draft_K]`` at positions ``[len, ..,
+    len+K]``) and the step returns TRUE logits at every one of the first
+    ``n_spec`` columns — ``[n_slots, n_spec, V]`` instead of ``[n_slots,
+    V]`` — so the engine can verify all rows' drafts in one program.
+    Each verified column's attention is computed op-for-op the decode
+    einsum above (NOT the chunk einsum): per-position logits are then
+    BITWISE what ``n_spec`` sequential single-token steps would have
+    produced (projections are row-stable across the padded token width on
+    this backend — the same property the PR 11/12 decode-rows-ride-chunk
+    parity already leaned on — and every masked gather position
+    contributes exactly-zero probability, so KV bytes scattered this step
+    by later columns, or left stale by a previous step's rejected drafts,
+    are bitwise invisible to earlier columns; pinned by
+    ``tests/test_speculative.py``). The chunk row (``has_chunk``) still
+    emits from its ``emit_off`` column, replicated across the logits
+    axis. ``n_spec == 1`` keeps the graph byte-identical to the
+    pre-speculative build.
     """
     from photon_tpu.models.decode import _layer_adapters
     from photon_tpu.ops.ragged_paged_attention import ragged_paged_attention
@@ -302,8 +323,11 @@ def mixed_chunk_step(params: dict, state: PagedState, tokens: jax.Array,
     off = positions % bs
     rows = jax.lax.slice_in_dim(state.block_tables, 0, n_ctx, axis=1)
     k_pos = jnp.arange(s_ctx)
-    pos0 = positions[:, 0]  # decode-column positions
-    valid0 = k_pos[None, :] <= pos0[:, None]  # [B, s_ctx]
+    # decode-column positions/masks: one per VERIFIED column (n_spec == 1
+    # is the classic single-decode-column step)
+    pos_cols = [positions[:, i] for i in range(n_spec)]
+    valid_cols = [k_pos[None, :] <= p[:, None] for p in pos_cols]  # [B, s_ctx]
+    pos0 = pos_cols[0]
     if has_chunk:
         pos_c = jax.lax.dynamic_index_in_dim(
             positions, chunk_slot, axis=0, keepdims=False
@@ -334,29 +358,43 @@ def mixed_chunk_step(params: dict, state: PagedState, tokens: jax.Array,
         ck = ck.at[phys, off].set(k_new.astype(ck.dtype))
         cv = cv.at[phys, off].set(v_new.astype(cv.dtype))
         if impl == "ragged":
-            out0 = ragged_paged_attention(
-                q[:, :1], ck, cv, rows, pos0[:, None], scale=scale,
+            out_spec = ragged_paged_attention(
+                q[:, :n_spec], ck, cv, rows, positions[:, :n_spec],
+                scale=scale,
                 slopes=alibi_slopes(cfg.n_heads) if cfg.alibi else None,
                 interpret=interpret,
-            )[:, 0]  # [B, H, Dh]
+            )  # [B, n_spec, H, Dh]
         else:
-            # decode columns: op-for-op paged_decode_step
             gk = ck[rows].reshape(n_slots, s_ctx, n_kv, cfg.d_head)
             gv = cv[rows].reshape(n_slots, s_ctx, n_kv, cfg.d_head)
-            qg = q[:, 0].reshape(n_slots, n_kv, group, cfg.d_head)
-            scores = jnp.einsum("bkgd,bskd->bkgs", qg, gk,
-                                preferred_element_type=jnp.float32) * scale
-            if cfg.alibi:
-                dist = (pos0[:, None] - k_pos[None, :]).astype(jnp.float32)
-                slopes = alibi_slopes(cfg.n_heads).reshape(n_kv, group)
-                scores = scores - slopes[None, :, :, None] * dist[:, None, None, :]
-            scores = jnp.where(valid0[:, None, None, :], scores, -jnp.inf)
-            probs = jax.nn.softmax(scores, axis=-1)
-            out0 = jnp.einsum("bkgs,bskd->bkgd", probs.astype(gv.dtype), gv)
-            out0 = out0.reshape(n_slots, cfg.n_heads, cfg.d_head)
+
+            def dec_col(i):
+                # one verified column: op-for-op paged_decode_step. The
+                # shared gather is safe bitwise — columns > i's scatters
+                # sit past this column's position, where the mask makes
+                # their probability exactly zero
+                qg = q[:, i].reshape(n_slots, n_kv, group, cfg.d_head)
+                scores = jnp.einsum("bkgd,bskd->bkgs", qg, gk,
+                                    preferred_element_type=jnp.float32) * scale
+                if cfg.alibi:
+                    dist = (pos_cols[i][:, None]
+                            - k_pos[None, :]).astype(jnp.float32)
+                    slopes = alibi_slopes(cfg.n_heads).reshape(n_kv, group)
+                    scores = scores - slopes[None, :, :, None] * dist[:, None, None, :]
+                scores = jnp.where(valid_cols[i][:, None, None, :],
+                                   scores, -jnp.inf)
+                probs = jax.nn.softmax(scores, axis=-1)
+                out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(gv.dtype), gv)
+                return out.reshape(n_slots, cfg.n_heads, cfg.d_head)
+
+            out_spec = jnp.stack([dec_col(i) for i in range(n_spec)], axis=1)
         attn = jnp.broadcast_to(
-            out0[:, None], (n_slots, tq, cfg.n_heads, cfg.d_head)
+            out_spec[:, :1], (n_slots, tq, cfg.n_heads, cfg.d_head)
         )
+        if n_spec > 1:
+            attn = jax.lax.dynamic_update_slice_in_dim(
+                attn, out_spec.astype(attn.dtype), 0, axis=1
+            )
         if has_chunk:
             qc = jax.lax.dynamic_index_in_dim(
                 q, chunk_slot, axis=0, keepdims=False
@@ -395,8 +433,27 @@ def mixed_chunk_step(params: dict, state: PagedState, tokens: jax.Array,
     if adapters is not None:
         xs = xs + (ad_l,)
     x, (ck_l, cv_l) = jax.lax.scan(layer, x, xs)
-    last = jnp.take_along_axis(x, emit_off[:, None, None], axis=1)[:, 0]
-    return _logits(params, last, cfg), PagedState(
+    if n_spec == 1:
+        last = jnp.take_along_axis(x, emit_off[:, None, None], axis=1)[:, 0]
+        lg = _logits(params, last, cfg)  # [B, V]
+    else:
+        # the verify grid: decode rows read columns 0..n_spec-1; the chunk
+        # row reads its emit column (replicated — its later acceptance
+        # loop only ever consumes emission 0)
+        vcols = jnp.broadcast_to(
+            jnp.arange(n_spec, dtype=jnp.int32), (n_slots, n_spec)
+        )
+        if has_chunk:
+            off_c = jax.lax.dynamic_index_in_dim(
+                emit_off, chunk_slot, keepdims=False
+            )
+            vcols = jax.lax.dynamic_update_index_in_dim(
+                vcols, jnp.full((n_spec,), off_c, jnp.int32), chunk_slot,
+                axis=0,
+            )
+        sel = jnp.take_along_axis(x, vcols[:, :, None], axis=1)  # [B,n_spec,D]
+        lg = _logits(params, sel, cfg)  # [B, n_spec, V]
+    return lg, PagedState(
         cache_k=jnp.moveaxis(ck_l, 0, 1),
         cache_v=jnp.moveaxis(cv_l, 0, 1),
         block_tables=state.block_tables,

@@ -1,0 +1,222 @@
+"""Host-side draft proposal for speculative decoding (ISSUE 15).
+
+Speculative decoding splits token generation into a cheap PROPOSER and
+the model as VERIFIER: a drafter guesses the next K tokens of a slot's
+stream, the engine runs all K (plus the pending last token) through ONE
+mixed-grid step (``serve/cache.py:mixed_chunk_step`` with ``n_spec > 1``
+— the same compiled program shape chunked prefill already runs), and the
+longest accepted prefix plus one true model token is emitted. A perfect
+draft turns K+1 sequential decode steps into one step; a useless draft
+costs one slightly-wider step — and the scheduler's accept-rate
+throttle (:class:`SpecController`) turns drafting off before useless
+becomes a regression.
+
+This module is deliberately MODEL-FREE: the first :class:`Drafter`
+implementation is n-gram / prompt-lookup drafting over each slot's own
+``prompt + generated`` history — zero extra weights, zero device work,
+and strongest exactly on the templated/shared-prefix traffic the prefix
+cache already targets (extractive answers, code infill, structured
+formats, and the short cycles small models fall into). A
+learned/distilled draft model would implement the same three-call
+surface and drop in at the scheduler without touching the engine.
+
+Thread-discipline: all of this is driver-thread-only state (the
+scheduler loop owns it), like the engine's host mirrors.
+"""
+
+from __future__ import annotations
+
+
+class Drafter:
+    """Per-slot draft proposal surface. Lifecycle mirrors the engine's
+    slot lifecycle: :meth:`begin` at admission, :meth:`observe` after
+    every emission burst, :meth:`end` at eviction. ``propose`` must be
+    PURE with respect to device state — drafts are suggestions; the
+    verify step is the only authority on what gets emitted."""
+
+    def begin(self, slot: int, prompt: list[int]) -> None:
+        raise NotImplementedError
+
+    def observe(self, slot: int, tokens: list[int]) -> None:
+        """``tokens`` were emitted (accepted + bonus) for ``slot``."""
+        raise NotImplementedError
+
+    def propose(self, slot: int, k: int) -> list[int]:
+        """Up to ``k`` draft tokens continuing ``slot``'s stream (may be
+        empty — a draft-less row rides the step as plain decode)."""
+        raise NotImplementedError
+
+    def end(self, slot: int) -> None:
+        raise NotImplementedError
+
+
+class NGramDrafter(Drafter):
+    """Prompt-lookup / n-gram drafting over each slot's own history.
+
+    For orders ``max_ngram .. min_ngram`` (longest first), the drafter
+    looks up the context's trailing n-gram in an incrementally-maintained
+    index of the slot's ``prompt + generated`` tokens and proposes the
+    tokens that followed the MOST RECENT earlier occurrence. Cost is
+    O(orders) per update and per proposal — a dict probe, no scan — so
+    drafting adds host-side nanoseconds to a step that saves whole model
+    invocations.
+
+    Recency wins (the index keeps each n-gram's latest continuation):
+    generation loops, repeated template fields and copied spans are
+    exactly the latest-occurrence patterns.
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1) -> None:
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"{min_ngram}/{max_ngram}"
+            )
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+        #: slot -> full token history (prompt + emitted)
+        self._ctx: dict[int, list[int]] = {}
+        #: slot -> {order -> {ngram tuple -> (latest, previous) positions
+        #: AFTER the ngram}}. Two positions, because the context's own
+        #: trailing n-gram is its own latest occurrence — with only one
+        #: slot, a repeating tail would overwrite exactly the match it
+        #: needs (the previous occurrence's continuation)
+        self._index: dict[int, dict[int, dict[tuple, tuple[int, int]]]] = {}
+
+    def begin(self, slot: int, prompt: list[int]) -> None:
+        self._ctx[slot] = []
+        self._index[slot] = {
+            n: {} for n in range(self.min_ngram, self.max_ngram + 1)
+        }
+        self._extend(slot, list(prompt))
+
+    def observe(self, slot: int, tokens: list[int]) -> None:
+        if slot in self._ctx:
+            self._extend(slot, list(tokens))
+
+    def end(self, slot: int) -> None:
+        self._ctx.pop(slot, None)
+        self._index.pop(slot, None)
+
+    def _extend(self, slot: int, tokens: list[int]) -> None:
+        """Append tokens and index every newly-completed n-gram. The
+        index maps an n-gram to the position just past it (== the index
+        of its continuation token); (latest, previous) are kept so the
+        trailing n-gram — whose "continuation" doesn't exist yet — still
+        exposes its previous occurrence's continuation."""
+        ctx = self._ctx[slot]
+        idx = self._index[slot]
+        for tok in tokens:
+            ctx.append(int(tok))
+            end = len(ctx)
+            for n in range(self.min_ngram, self.max_ngram + 1):
+                if end >= n:
+                    key = tuple(ctx[end - n:end])
+                    prev = idx[n].get(key)
+                    idx[n][key] = (end, prev[0] if prev else -1)
+
+    def propose(self, slot: int, k: int) -> list[int]:
+        """Self-extending proposal: guess one token at a time from the
+        (virtual) context ``ctx + draft-so-far``, so a period-``p``
+        repetition still yields a full-depth draft instead of ``p``
+        tokens. Each guess is O(orders) dict probes."""
+        ctx = self._ctx.get(slot)
+        if ctx is None or k < 1:
+            return []
+        idx = self._index[slot]
+        out: list[int] = []
+        while len(out) < k:
+            tok = self._guess_next(ctx, out, idx)
+            if tok is None:
+                break
+            out.append(tok)
+        return out
+
+    def _guess_next(self, ctx: list[int], out: list[int],
+                    idx: dict[int, dict[tuple, tuple[int, int]]]
+                    ) -> int | None:
+        tail = ctx[-self.max_ngram:] + out if out else ctx
+        end = len(ctx) + len(out)
+        for n in range(self.max_ngram, self.min_ngram - 1, -1):
+            if end < n:
+                continue
+            hit = idx[n].get(tuple(tail[-n:]))
+            if hit is None:
+                continue
+            # a continuation index must point INSIDE ctx: the latest
+            # occurrence of the context's own trailing gram has none yet
+            pos = hit[0] if hit[0] < len(ctx) else hit[1]
+            if 0 <= pos < len(ctx):
+                return ctx[pos]
+        return None
+
+
+class SpecController:
+    """Accept-rate EWMA → draft-depth throttle (ISSUE 15).
+
+    The scheduler feeds every drafted step's ``(drafted, accepted)``
+    counts in; :meth:`k_effective` answers "how deep should the next
+    step's drafts be?". The policy:
+
+    - ``ewma >= accept_floor`` → ``K`` scales PROPORTIONALLY with the
+      EWMA (``round(ewma * k_max)``, at least 1): half the drafts
+      landing → half the depth, so the wasted verify columns shrink
+      before drafting turns off entirely;
+    - ``ewma < accept_floor`` → ``K = 0`` (plain decode: the classic
+      step on the classic compiled program — adversarial/incompressible
+      traffic pays nothing but the EWMA bookkeeping), EXCEPT one
+      single-token probe every ``probe_ticks`` ticks so a throttled-off
+      engine notices when traffic turns templated again (``probe_ticks=0``
+      disables probing: once off, stays off).
+
+    The EWMA starts at 1.0 — optimistic, so drafting engages immediately
+    and earns (or loses) its keep on real traffic within a few steps.
+    """
+
+    def __init__(self, k_max: int, accept_floor: float = 0.3,
+                 ewma_alpha: float = 0.2, probe_ticks: int = 64) -> None:
+        if k_max < 1:
+            raise ValueError(f"need k_max >= 1, got {k_max}")
+        self.k_max = k_max
+        self.accept_floor = accept_floor
+        self.ewma_alpha = ewma_alpha
+        self.probe_ticks = probe_ticks
+        self.ewma = 1.0
+        # cumulative counters (the serve/spec_* KPI feed)
+        self.drafted = 0
+        self.accepted = 0
+        self.spec_steps = 0
+        self._ticks_throttled = 0
+
+    def k_effective(self) -> int:
+        """The throttle's CURRENT depth (pure — the KPI gauge reads this
+        without advancing the probe clock). 0 = plain decode."""
+        if self.ewma >= self.accept_floor:
+            return max(1, min(self.k_max, round(self.ewma * self.k_max)))
+        return 0
+
+    def next_k(self) -> int:
+        """Draft depth for the NEXT step — call exactly once per
+        scheduler step phase (it advances the probe clock while
+        throttled off)."""
+        k = self.k_effective()
+        if k:
+            self._ticks_throttled = 0
+            return k
+        self._ticks_throttled += 1
+        if self.probe_ticks and self._ticks_throttled >= self.probe_ticks:
+            self._ticks_throttled = 0
+            return 1  # the probe: one cheap draft column
+        return 0
+
+    def observe(self, drafted: int, accepted: int) -> None:
+        """Fold one drafted step's counts into the EWMA (steps that
+        carried no draft don't move it — an idle engine must not decay
+        toward the floor)."""
+        if drafted < 1:
+            return
+        self.drafted += drafted
+        self.accepted += accepted
+        self.spec_steps += 1
+        rate = accepted / drafted
+        self.ewma += self.ewma_alpha * (rate - self.ewma)

@@ -21,9 +21,11 @@ BLOCK count exactly like the old prefill (<= ``log2(max_blocks)+1``
 shapes, and a chunk's width depends only on its own request + the chunk
 budget — never on batch-mates); decode-only steps are ``Tq == 1``; the
 live width ``n_ctx`` is a pow2 bucket of the longest ACTIVE reservation
-and rises MONOTONICALLY (high-water) — it never shrinks, so a warm
+and rises MONOTONICALLY (high-water) while any slot is live — it resets
+only when the engine goes FULLY idle (see ``_ctx_width``), so a warm
 engine's bucket set is a deterministic function of the traffic profile,
-not of admission timing. ``serve.attention_impl`` picks the attention
+not of admission timing. Speculative verify widths (``n_spec``) bucket
+to pow2 the same way. ``serve.attention_impl`` picks the attention
 inner graph: the bit-exact gather reference or the fused Pallas ragged
 kernel (``ops/ragged_paged_attention.py``).
 
@@ -66,6 +68,14 @@ from photon_tpu.serve.cache import (
 from photon_tpu.serve.prefix import PrefixCache, prefix_hashes
 
 
+def _pow2_bucket(n: int) -> int:
+    """The shape-bucketing rule, in ONE place: smallest power of two
+    covering ``n`` (minimum 1). Chunk widths, the live attention width
+    and the speculative verify width all bucket through this — the
+    retrace-sentinel tests lean on every site agreeing."""
+    return 1 << (max(1, n) - 1).bit_length()
+
+
 def _sample_rows(logits: jax.Array, temps: jax.Array,
                  keys: jax.Array) -> jax.Array:
     """Per-row greedy/temperature sampling: ``temps[b] == 0`` → argmax."""
@@ -73,6 +83,86 @@ def _sample_rows(logits: jax.Array, temps: jax.Array,
     scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
     sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+
+
+def _verify_rows(logits: jax.Array, tokens: jax.Array, temps: jax.Array,
+                 keys: jax.Array, emit_mask: jax.Array, n_valid: jax.Array,
+                 n_spec: int) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Speculative acceptance over the verify grid (ISSUE 15): emission
+    ``i`` consumes the TRUE logits at column ``i`` (``logits [B, n_spec,
+    V]``); the draft it tests sits at column ``i + 1`` of ``tokens``.
+
+    - **greedy rows** (``temps <= 0``): longest-matching-prefix — emit
+      ``argmax`` at every live column and keep going while the next draft
+      equals it. The emitted stream is exactly what sequential
+      single-token steps would emit (per-column logits are bitwise equal
+      — see ``mixed_chunk_step``), so greedy speculative output is
+      BIT-EXACT vs the non-speculative engine.
+    - **temperature rows**: standard rejection sampling against the
+      drafter's point-mass proposal — accept draft ``d`` with probability
+      ``p(d)`` (``u < p(d)``), on rejection sample from the residual
+      ``p`` with ``d``'s mass removed, and stop. Distribution-preserving
+      per position; the SAMPLE PATH differs from the non-speculative
+      engine (pinned statistically in tests, not bitwise).
+
+    A row with no draft at a live column (``i + 1 >= n_valid`` — the
+    plain decode row, or the last column's bonus emission) emits through
+    the ordinary full-sample path. Per-slot PRNG chains advance once per
+    EMITTED token with EXACTLY the classic step's split discipline —
+    ``s_key_m, k_{m+1} = split(k_m)``, the rejection test's extra
+    uniforms derived from ``s_key_m`` and consumed only by drafted rows
+    — so a seeded stream's m-th emission always draws from the same key
+    regardless of how emissions grouped into steps, and a row that
+    carries no draft samples BITWISE what the classic ``n_spec == 1``
+    program would have sampled: batch-mates' chunk/draft schedules can
+    never perturb a non-drafting row's stream.
+
+    Returns ``(emitted tokens [B, n_spec] — zeros past each row's count,
+    n_emitted [B], advanced keys)``.
+    """
+    B, _, V = logits.shape
+    greedy_rows = temps <= 0.0
+    live = emit_mask
+    k = keys
+    n_em = jnp.zeros(B, jnp.int32)
+    outs = []
+    for i in range(n_spec):
+        lg = logits[:, i]
+        sub = jax.vmap(jax.random.split)(k)  # [B, 2, 2] — the classic chain
+        s_key, k_next = sub[:, 0], sub[:, 1]
+        # the bonus emission IS the classic sampling rule — one helper,
+        # so the non-drafting-row-samples-bitwise-classic invariant can't
+        # drift
+        bonus_tok = _sample_rows(lg, temps, s_key)
+        if i + 1 < n_spec:
+            draft = tokens[:, i + 1]
+            has_draft = (i + 1) < n_valid  # [B]
+            greedy_tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            scaled = lg.astype(jnp.float32) / jnp.maximum(temps,
+                                                          1e-6)[:, None]
+            p = jax.nn.softmax(scaled, axis=-1)
+            p_draft = jnp.take_along_axis(p, draft[:, None], axis=1)[:, 0]
+            usub = jax.vmap(jax.random.split)(s_key)  # [B, 2, 2]
+            u = jax.vmap(jax.random.uniform)(usub[:, 0])
+            # the rejection residual: p with the draft's mass removed —
+            # log(0) = -inf rows are unreachable (p(d) == 1 always accepts)
+            resid = jnp.where(jnp.arange(V)[None, :] == draft[:, None], 0.0, p)
+            resid_tok = jax.vmap(jax.random.categorical)(
+                usub[:, 1], jnp.log(resid)
+            ).astype(jnp.int32)
+            accept = jnp.where(greedy_rows, draft == greedy_tok, u < p_draft)
+            cont = accept & has_draft
+            corr = jnp.where(greedy_rows, greedy_tok, resid_tok)
+            emit_tok = jnp.where(has_draft, jnp.where(cont, draft, corr),
+                                 bonus_tok)
+        else:
+            cont = jnp.zeros(B, bool)
+            emit_tok = bonus_tok
+        outs.append(jnp.where(live, emit_tok, 0))
+        n_em = n_em + live.astype(jnp.int32)
+        k = jnp.where(live[:, None], k_next, k)
+        live = live & cont
+    return jnp.stack(outs, axis=1), n_em, k
 
 
 def load_serving_params(cfg: Config, mgr: Any, server_round: int) -> Any:
@@ -216,7 +306,8 @@ class PagedEngine:
 
         def step_fn(params, state, tokens, positions, q_valid, emit_off,
                     emit_mask, lengths_after, chunk_slot, temps, keys,
-                    apool, arows, *, n_ctx, has_chunk):
+                    apool, arows, n_valid, dec_mask, *, n_ctx, has_chunk,
+                    n_spec=1):
             adapters = None
             if has_adapters:
                 # per-slot page gather (fixed shape: [B] rows into the
@@ -235,18 +326,33 @@ class PagedEngine:
                 impl="ragged" if use_kernel else "gather",
                 interpret=interp,
                 adapters=adapters, lora_scale=a_scale,
+                n_spec=n_spec,
             )
-            sub = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
-            nxt = _sample_rows(logits, temps, sub[:, 0])
-            nxt = jnp.where(emit_mask, nxt, 0)
-            # a slot's PRNG stream advances only when it emits: the chunk
-            # schedule (how many steps a batch-mate's prefill took) can
-            # never perturb another request's sampled completion
-            keys = jnp.where(emit_mask[:, None], sub[:, 1], keys)
-            return state, nxt, keys
+            if n_spec == 1:
+                sub = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+                nxt = _sample_rows(logits, temps, sub[:, 0])
+                nxt = jnp.where(emit_mask, nxt, 0)
+                # a slot's PRNG stream advances only when it emits: the
+                # chunk schedule (how many steps a batch-mate's prefill
+                # took) can never perturb another request's sampled
+                # completion
+                keys = jnp.where(emit_mask[:, None], sub[:, 1], keys)
+                return state, nxt[:, None], emit_mask.astype(jnp.int32), keys
+            # speculative grid (ISSUE 15): acceptance runs IN-GRAPH so a
+            # draft burst costs one host round-trip, and decode rows'
+            # lengths roll FORWARD only over accepted positions — the
+            # rejected tail's KV bytes stay behind the k_pos <= position
+            # mask until a later accepted write overwrites them
+            out, n_em, keys = _verify_rows(
+                logits, tokens, temps, keys, emit_mask, n_valid, n_spec
+            )
+            state = state.replace(lengths=jnp.where(
+                dec_mask, positions[:, 0] + n_em, state.lengths
+            ))
+            return state, out, n_em, keys
 
         self._mixed_jit = jax.jit(
-            step_fn, static_argnames=("n_ctx", "has_chunk"),
+            step_fn, static_argnames=("n_ctx", "has_chunk", "n_spec"),
             donate_argnums=(1, 10),
         )
         self._install_jit = jax.jit(install_row, donate_argnums=0)
@@ -446,14 +552,26 @@ class PagedEngine:
         path BITWISE stable: XLA's row lowering is block-count invariant
         on the pinned shapes, single-row einsums are not."""
         need = max(1, -(-n_tokens // self.block_size))
-        return min(1 << (need - 1).bit_length(), self.max_blocks) * self.block_size
+        return min(_pow2_bucket(need), self.max_blocks) * self.block_size
 
     def _ctx_width(self) -> int:
         """The step's live attention width in blocks: pow2 bucket of the
-        longest ACTIVE reservation, monotone high-water (never shrinks) —
-        a warm engine's compiled widths are a function of the traffic
-        profile, not of which requests happened to overlap. The 'gather'
-        impl pins it at full table width (the PR 5 cost model)."""
+        longest ACTIVE reservation, monotone high-water (never shrinks
+        WHILE ANY SLOT IS LIVE) — a warm engine's compiled widths are a
+        function of the traffic profile, not of which requests happened
+        to overlap. The 'gather' impl pins it at full table width (the
+        PR 5 cost model).
+
+        A fully-idle engine resets the high-water (:meth:`evict` — ISSUE
+        15 satellite): before the reset, one long request permanently
+        inflated every later batch's attention width for the daemon's
+        lifetime. The trade is a BOUNDED recompile exposure: after a
+        reset, a traffic profile whose width sequence differs from the
+        pre-reset warmup can reach pow2 widths that were never compiled —
+        at most ``log2(max_blocks)+1`` of them, ever, because the bucket
+        SET is the same pow2 family (jit caches persist across resets, so
+        identical post-reset traffic replays the warm programs and the
+        retrace sentinel stays green — pinned in tests)."""
         if self._ctx_full:
             return self.max_blocks
         need = max(
@@ -461,7 +579,7 @@ class PagedEngine:
              if self._active[s]),
             default=1,
         )
-        w = min(1 << (max(1, need) - 1).bit_length(), self.max_blocks)
+        w = min(_pow2_bucket(need), self.max_blocks)
         self._ctx_hw = max(self._ctx_hw, w)
         return self._ctx_hw
 
@@ -559,6 +677,12 @@ class PagedEngine:
             self.prefix_cache.tokens_seen += n
             self.prefix_cache.tokens_cached += k * self.block_size
 
+    def _spec_bucket(self, n: int) -> int:
+        """Verify-grid width: pow2 bucket of ``1 + max drafts`` so the
+        speculative step compiles at most ``log2(k)+2`` distinct widths
+        (the same discipline as :meth:`_bucket`'s chunk widths)."""
+        return _pow2_bucket(n)
+
     def mixed_step(self, chunk: tuple[int, int] | None = None, *,
                    include_decode: bool = True
                    ) -> tuple[np.ndarray, np.ndarray]:
@@ -571,11 +695,44 @@ class PagedEngine:
         FIRST sampled token). ``include_decode=False`` runs the chunk
         alone (the synchronous :meth:`admit` path — batch-mates' streams
         must not advance)."""
+        out, n_em = self._grid_step(chunk, include_decode, {})
+        return out[:, 0], n_em > 0
+
+    def spec_step(self, chunk: tuple[int, int] | None = None,
+                  drafts: dict[int, list[int]] | None = None, *,
+                  include_decode: bool = True
+                  ) -> tuple[np.ndarray, np.ndarray]:
+        """The speculative generalization of :meth:`mixed_step` (ISSUE
+        15): ``drafts`` maps decoding slots to proposed continuation
+        tokens; EVERY drafted row verifies its whole draft in this one
+        step. Returns ``(tokens [n_slots, n_spec], n_emitted [n_slots])``
+        — row ``s`` emitted ``tokens[s, :n_emitted[s]]``, in order (the
+        accepted draft prefix plus one model-sampled token; exactly one
+        token for draft-less rows, so ``drafts={}`` degenerates to the
+        classic step on the classic compiled program)."""
+        return self._grid_step(chunk, include_decode, drafts or {})
+
+    def _grid_step(self, chunk: tuple[int, int] | None,
+                   include_decode: bool, drafts: dict[int, list[int]]
+                   ) -> tuple[np.ndarray, np.ndarray]:
         B = self.n_slots
         decode_slots = [
             s for s in range(B)
             if include_decode and self._active[s] and s not in self._pending
         ]
+        # defensive trim: a draft may never write past the slot's block
+        # reservation (the scheduler already caps by remaining max_new;
+        # positions len..len+K must stay inside the reserved row)
+        drafts = {
+            s: d[: max(0, len(self._slot_blocks[s]) * self.block_size
+                       - int(self._lengths[s]) - 1)]
+            for s, d in drafts.items()
+            if s in decode_slots and d
+        }
+        drafts = {s: d for s, d in drafts.items() if d}
+        n_spec = self._spec_bucket(
+            1 + max((len(d) for d in drafts.values()), default=0)
+        )
         seg: list[int] = []
         cs = 0
         final = False
@@ -589,19 +746,32 @@ class PagedEngine:
             final = p.pos + cn == p.n
         if not seg and not decode_slots:
             raise RuntimeError("mixed_step with no work")
-        tq = self._bucket(len(seg)) if seg else 1
+        tq = max(self._bucket(len(seg)) if seg else 1, n_spec)
         tokens = np.zeros((B, tq), np.int32)
         positions = np.zeros((B, tq), np.int32)
         q_valid = np.zeros((B, tq), bool)
         emit_off = np.zeros(B, np.int32)
         emit_mask = np.zeros(B, bool)
+        n_valid = np.ones(B, np.int32)
+        dec_mask = np.zeros(B, bool)
         lengths_after = self._lengths.copy()
         for s in decode_slots:
+            ds = drafts.get(s, [])
+            nv = 1 + len(ds)
             tokens[s, 0] = self._last[s]
-            positions[s, 0] = self._lengths[s]
-            q_valid[s, 0] = True
+            if ds:
+                tokens[s, 1:nv] = ds
+            positions[s, :nv] = np.arange(self._lengths[s],
+                                          self._lengths[s] + nv)
+            q_valid[s, :nv] = True
             emit_mask[s] = True
-            lengths_after[s] += 1
+            n_valid[s] = nv
+            dec_mask[s] = True
+            if n_spec == 1:
+                lengths_after[s] += 1
+            # n_spec > 1: the device step rolls decode rows' lengths
+            # forward by the ACCEPTED count (dec_mask gates the splice) —
+            # the host mirror catches up from n_emitted below
         if seg:
             p = self._pending[cs]
             cn = len(seg)
@@ -613,32 +783,44 @@ class PagedEngine:
                 emit_off[cs] = cn - 1
                 emit_mask[cs] = True
         pool = self.adapter_pool
-        self.state, nxt, self._keys = self._mixed_call(
+        # n_spec rides as a kwarg ONLY when drafting widened the grid, so
+        # pre-speculative _mixed_call overrides (test seams, spies) keep
+        # working untouched on every classic step
+        spec_kw = {} if n_spec == 1 else {"n_spec": n_spec}
+        self.state, nxt, n_emitted, self._keys = self._mixed_call(
             self._ctx_width(), bool(seg), self.params, self.state,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(q_valid),
             jnp.asarray(emit_off), jnp.asarray(emit_mask),
             jnp.asarray(lengths_after), jnp.int32(cs), self._temps, self._keys,
             pool.leaves() if pool is not None else (),
             jnp.asarray(self._adapter_rows),
+            jnp.asarray(n_valid), jnp.asarray(dec_mask),
+            **spec_kw,
         )
-        out = np.asarray(nxt)
+        out = np.asarray(nxt)  # [B, n_spec]
+        n_em = np.asarray(n_emitted)  # [B]
         self._lengths = lengths_after
         for s in decode_slots:
-            self._last[s] = out[s]
+            n = int(n_em[s])
+            if n_spec > 1:
+                self._lengths[s] += n
+            self._last[s] = out[s, max(0, n - 1)]
         if seg:
             p = self._pending[cs]
             p.pos += len(seg)
             if final:
-                self._last[cs] = out[cs]
+                self._last[cs] = out[cs, 0]
                 self._finish_prefill(cs, p)
-        return out, emit_mask
+        return out, n_em
 
-    def _mixed_call(self, n_ctx: int, has_chunk: bool, *args):
+    def _mixed_call(self, n_ctx: int, has_chunk: bool, *args,
+                    n_spec: int = 1):
         """The one seam between host bookkeeping and the donated device
         call (tests inject failures here: raising BEFORE the jitted call
         leaves the donated state untouched, so a failed step is
         recoverable at the scheduler layer)."""
-        return self._mixed_jit(*args, n_ctx=n_ctx, has_chunk=has_chunk)
+        return self._mixed_jit(*args, n_ctx=n_ctx, has_chunk=has_chunk,
+                               n_spec=n_spec)
 
     def _finish_prefill(self, slot: int, p: _Prefill) -> None:
         """Prompt fully prefilled: index its full blocks for the next
@@ -702,3 +884,11 @@ class PagedEngine:
         self._active[slot] = False
         self._last[slot] = 0
         self._lengths[slot] = 0
+        if not self._active.any():
+            # fully idle: drop the live-width high-water (ISSUE 15
+            # satellite) so one long-dead request stops inflating every
+            # later batch's attention width. Compiled widths stay cached
+            # in _mixed_jit, so re-warming the same traffic profile
+            # compiles nothing — see _ctx_width for the bounded-recompile
+            # trade on a CHANGED profile
+            self._ctx_hw = 1

@@ -116,6 +116,9 @@ class ServeFrontend:
                     prefix = eng.prefix_stats()
                     if prefix is not None:
                         payload["prefix_cache"] = prefix
+                    sp = getattr(fe.batcher, "spec_stats", None)
+                    if sp is not None and (s := sp()) is not None:
+                        payload["speculative"] = s
                     ast = getattr(eng, "adapter_stats", None)
                     if ast is not None and (a := ast()) is not None:
                         a["serving"] = eng.adapter_pool.cohorts()

@@ -19,7 +19,13 @@ The serving loop (one driver thread) interleaves two phases forever:
    that hit their EOS or ``max_new_tokens`` are evicted immediately and
    their blocks/slot recycled, so the next iteration's admit phase refills
    mid-flight. That refill is the whole tokens/s win over batch-synchronous
-   serving (``bench.py --serving`` measures it).
+   serving (``bench.py --serving`` measures it). With speculative decoding
+   on (``serve.speculative``, ISSUE 15), every decoding row may
+   additionally carry up to K drafter-proposed tokens, verified in the
+   SAME step — the accepted prefix plus one model token all emit at once,
+   under a per-tick draft budget composed with ``prefill_token_budget``
+   and an accept-rate EWMA that throttles K down to plain decode on
+   incompressible traffic (``serve/draft.py``).
 
 Backpressure is reject-not-buffer: :meth:`ContinuousBatcher.submit` raises
 :class:`QueueFullError` when ``max_queue`` requests are already waiting —
@@ -80,6 +86,11 @@ from photon_tpu.utils.profiling import (
     SERVE_REJECTED,
     SERVE_REQUEST_SPAN,
     SERVE_SLOT_OCCUPANCY,
+    SERVE_SPEC_ACCEPT_RATE,
+    SERVE_SPEC_ACCEPTED,
+    SERVE_SPEC_DRAFTED,
+    SERVE_SPEC_K,
+    SERVE_SPEC_STEPS,
     SERVE_TOKENS_PER_S,
     SERVE_TPOT_S,
     SERVE_TTFT_S,
@@ -152,12 +163,39 @@ class ContinuousBatcher:
                  prefill_token_budget: int = 2048,
                  default_eos_id: int | None = None,
                  batch_synchronous: bool = False,
-                 history: History | None = None) -> None:
+                 history: History | None = None,
+                 speculative=None, drafter=None) -> None:
         self.engine = engine
         self.max_queue = max_queue
         self.prefill_token_budget = prefill_token_budget
         self.default_eos_id = default_eos_id
         self.batch_synchronous = batch_synchronous
+        # self-drafted speculative decoding (ISSUE 15, serve/draft.py):
+        # `speculative` is a SpeculativeConfig (photon.serve.speculative);
+        # `drafter` overrides the default NGramDrafter (tests, learned
+        # drafters). Silently ineligible for MoE — batch-global expert
+        # capacity breaks the per-row purity the verification leans on
+        # (the prefix cache makes the same call)
+        self._spec = None
+        self._drafter = None
+        self._spec_budget = 0
+        spec_on = speculative is not None and getattr(speculative, "enabled",
+                                                      False)
+        if spec_on and getattr(getattr(engine, "mc", None), "mlp",
+                               None) == "moe":
+            spec_on = False
+        if spec_on:
+            from photon_tpu.serve.draft import NGramDrafter, SpecController
+
+            self._drafter = drafter if drafter is not None else NGramDrafter(
+                speculative.max_ngram, speculative.min_ngram
+            )
+            self._spec = SpecController(
+                speculative.k, accept_floor=speculative.accept_floor,
+                ewma_alpha=speculative.ewma_alpha,
+                probe_ticks=speculative.probe_ticks,
+            )
+            self._spec_budget = speculative.draft_budget
         self.history = history if history is not None else History()
         self._queue: deque[ServeRequest] = deque()
         self._running: dict[int, ServeRequest] = {}  # slot -> request
@@ -371,6 +409,21 @@ class ContinuousBatcher:
         with self._lock:
             return len(self._queue)
 
+    def spec_stats(self) -> dict | None:
+        """Speculative-decoding counters for /healthz (None when off).
+        Lock-snapshotted like :meth:`stats` — the HTTP handler thread
+        must not observe a half-applied observe() update."""
+        if self._spec is None:
+            return None
+        with self._lock:
+            return {
+                "drafted": self._spec.drafted,
+                "accepted": self._spec.accepted,
+                "spec_steps": self._spec.spec_steps,
+                "accept_ewma": round(self._spec.ewma, 4),
+                "k": self._spec.k_effective(),
+            }
+
     def stats(self) -> dict[str, float]:
         with self._lock:
             out = {
@@ -383,6 +436,12 @@ class ContinuousBatcher:
                 SERVE_CHUNK_TOKENS: float(self.chunk_tokens),
                 SERVE_CHUNK_SPLIT_PROMPTS: float(self.chunk_split_prompts),
             }
+            if self._spec is not None:
+                out[SERVE_SPEC_DRAFTED] = float(self._spec.drafted)
+                out[SERVE_SPEC_ACCEPTED] = float(self._spec.accepted)
+                out[SERVE_SPEC_STEPS] = float(self._spec.spec_steps)
+                out[SERVE_SPEC_ACCEPT_RATE] = round(self._spec.ewma, 4)
+                out[SERVE_SPEC_K] = float(self._spec.k_effective())
             # getattr: fake/minimal engines (tests, alternative backends)
             # need not carry the checkpoint- or prefix-plane attributes
             rnd = getattr(self.engine, "loaded_round", None)
@@ -485,6 +544,8 @@ class ContinuousBatcher:
                 req._out.put(None)
                 continue
             self.admitted_order.append(req.rid)
+            if self._drafter is not None:
+                self._drafter.begin(slot, req.prompt)
             with self._lock:
                 self._running[slot] = req
             if self.engine.pending_tokens(slot) > self.prefill_token_budget:
@@ -514,21 +575,78 @@ class ContinuousBatcher:
             self.chunk_steps += 1
             self.chunk_tokens += chunk[1]
         t0 = time.monotonic()
-        nxt, emitted = self.engine.mixed_step(chunk)
+        if self._spec is None:
+            nxt, emitted = self.engine.mixed_step(chunk)
+            out = nxt[:, None]
+            n_em = emitted.astype(int)
+        else:
+            drafts = self._collect_drafts(running, chunk)
+            out, n_em = self.engine.spec_step(chunk, drafts)
+            self._spec.observe(
+                sum(len(d) for d in drafts.values()),
+                # accepted drafts per row = emissions minus the bonus
+                sum(max(0, int(n_em[s]) - 1) for s in drafts),
+            )
         dt = time.monotonic() - t0
         n_tokens = 0
         for slot in sorted(running):
-            if not emitted[slot]:
+            n = int(n_em[slot])
+            if n < 1:
                 continue  # mid-prefill: nothing to stream yet
             req = self._running.get(slot)
             if req is None or req.finished:
                 continue
             if not req.generated:
                 req.t_first = time.monotonic()  # the request's FIRST token
-            n_tokens += 1
-            self._push_token(slot, req, int(nxt[slot]))
+            burst = []
+            for j in range(n):
+                tok = int(out[slot, j])
+                burst.append(tok)
+                n_tokens += 1
+                self._push_token(slot, req, tok)
+                if req.finished:
+                    # EOS / max_new landed mid-burst: the tail of the
+                    # burst is discarded (its KV sits behind the evicted
+                    # slot's recycled blocks — never readable)
+                    break
+            if self._drafter is not None and not req.finished:
+                self._drafter.observe(slot, burst)
         if dt > 0 and n_tokens:
             self.history.record(self._tick, {SERVE_TOKENS_PER_S: n_tokens / dt})
+
+    def _collect_drafts(self, running: dict, chunk) -> dict[int, list[int]]:
+        """Per-tick draft assembly (ISSUE 15): ask the throttle for this
+        step's depth, then the drafter for each DECODING slot's guess,
+        under a per-tick token budget composed with the prefill budget —
+        a step already carrying a C-token chunk drafts at most
+        ``min(draft_budget, prefill_token_budget - C)`` so the grid's
+        total token work stays bounded by the same knob that bounds
+        chunks. Each row's depth is also capped at ``remaining - 1``
+        (drafting past max_new_tokens would verify tokens the request
+        can never emit)."""
+        k_eff = self._spec.next_k()
+        if k_eff < 1:
+            return {}
+        budget = self._spec_budget
+        if chunk is not None:
+            budget = min(budget, self.prefill_token_budget - chunk[1])
+        if budget < 1:
+            return {}
+        drafts: dict[int, list[int]] = {}
+        for slot, req in sorted(running.items()):
+            if req.finished or self.engine.pending_tokens(slot) > 0:
+                continue
+            k_s = min(k_eff, req.max_new_tokens - len(req.generated) - 1,
+                      budget)
+            if k_s < 1:
+                continue
+            d = self._drafter.propose(slot, k_s)
+            if d:
+                drafts[slot] = d
+                budget -= len(d)
+                if budget < 1:
+                    break
+        return drafts
 
     def _push_token(self, slot: int, req: ServeRequest, tok: int) -> None:
         req.generated.append(tok)
@@ -543,6 +661,8 @@ class ContinuousBatcher:
         req.error = error
         req.t_done = time.monotonic()
         self.engine.evict(slot)
+        if self._drafter is not None:
+            self._drafter.end(slot)
         with self._lock:
             self._running.pop(slot, None)
             self.evictions += 1
@@ -597,6 +717,15 @@ class ContinuousBatcher:
             hub.counter(SERVE_CHUNK_TOKENS).inc_to(stats[SERVE_CHUNK_TOKENS])
             hub.counter(SERVE_CHUNK_SPLIT_PROMPTS).inc_to(
                 stats[SERVE_CHUNK_SPLIT_PROMPTS])
+            if SERVE_SPEC_DRAFTED in stats:
+                hub.counter(SERVE_SPEC_DRAFTED).inc_to(
+                    stats[SERVE_SPEC_DRAFTED])
+                hub.counter(SERVE_SPEC_ACCEPTED).inc_to(
+                    stats[SERVE_SPEC_ACCEPTED])
+                hub.counter(SERVE_SPEC_STEPS).inc_to(stats[SERVE_SPEC_STEPS])
+                hub.gauge(SERVE_SPEC_ACCEPT_RATE).set(
+                    stats[SERVE_SPEC_ACCEPT_RATE])
+                hub.gauge(SERVE_SPEC_K).set(stats[SERVE_SPEC_K])
             if SERVE_ATTN_CTX_BLOCKS in stats:
                 hub.gauge(SERVE_ATTN_CTX_BLOCKS).set(
                     stats[SERVE_ATTN_CTX_BLOCKS])
@@ -708,6 +837,8 @@ def serve_history_kpis(history: History) -> dict[str, float]:
         for k in (SERVE_TTFT_S, SERVE_TOKENS_PER_S, SERVE_QUEUE_DEPTH,
                   SERVE_SLOT_OCCUPANCY, SERVE_EVICTIONS, SERVE_REJECTED,
                   SERVE_HOTSWAP_SWAPS_TOTAL, SERVE_HOTSWAP_ROUND,
-                  SERVE_PREFIX_HIT_RATE, SERVE_PREFIX_SHARED_BLOCKS)
+                  SERVE_PREFIX_HIT_RATE, SERVE_PREFIX_SHARED_BLOCKS,
+                  SERVE_SPEC_ACCEPT_RATE, SERVE_SPEC_ACCEPTED,
+                  SERVE_SPEC_DRAFTED)
         if (v := history.latest(k)) is not None
     }

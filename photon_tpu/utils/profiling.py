@@ -212,6 +212,21 @@ SERVE_CHUNK_TOKENS = "serve/chunk_tokens_total"
 #: token budget — the giant prompts that used to monopolize a step)
 SERVE_CHUNK_SPLIT_PROMPTS = "serve/chunk_split_prompts_total"
 
+# -- speculative decoding (ISSUE 15, serve/draft.py) -----------------------
+# Draft-and-verify counters (scheduler-owned, cumulative):
+#: draft tokens proposed to the verification grid
+SERVE_SPEC_DRAFTED = "serve/spec_drafted_total"
+#: draft tokens the model accepted (longest-matching-prefix for greedy,
+#: rejection-sampling for temperature rows)
+SERVE_SPEC_ACCEPTED = "serve/spec_accepted_total"
+#: scheduler steps that carried at least one drafted row
+SERVE_SPEC_STEPS = "serve/spec_steps_total"
+# Tick-time gauges:
+#: the accept-rate EWMA driving the auto-throttle (1.0 = every draft lands)
+SERVE_SPEC_ACCEPT_RATE = "serve/spec_accept_rate"
+#: the throttle's current per-row draft depth K (0 = plain decode)
+SERVE_SPEC_K = "serve/spec_k"
+
 # -- per-cohort LoRA personalization plane (ISSUE 13, photon_tpu/adapters) --
 # Train side (federation/collective_round.py grouped rounds):
 #: cohorts whose adapters updated this round (fused grouped reduction OR

@@ -190,7 +190,7 @@ def _spy_engine(cfg, params, bank):
 
             (params_, state, tokens, positions, q_valid, emit_off,
              emit_mask, lengths_after, chunk_slot, temps, keys,
-             apool, arows) = args
+             apool, arows) = args[:13]
             adapters = adapter_tree(
                 self._adapter_spec, [leaf[arows] for leaf in apool]
             )

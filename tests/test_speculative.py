@@ -1,0 +1,771 @@
+"""Speculative decoding through the ragged mixed-step grid (ISSUE 15).
+
+Contract layers:
+
+1. **the generalized grid itself** — a multi-token decode row's
+   per-position logits AND live KV bytes are BITWISE what K sequential
+   single-token ``mixed_step`` calls produce, across mpt-wpe / mpt-alibi
+   / llama-gqa, including a slot mid-prefill riding the same batch (the
+   satellite pin: the verify columns run op-for-op the decode einsum,
+   and masked gather positions are exactly-zero-probability invisible);
+2. **greedy end-to-end bit-exactness** — the speculative engine's token
+   streams equal the NON-speculative engine / offline oracle, including
+   mixed spec+chunk batches, prefix-cache hits, recycled blocks, EOS
+   mid-burst and max_new caps — and equal them even under an adversarial
+   drafter (rejected drafts roll back via lengths bookkeeping);
+3. **temperature** — seeded streams are reproducible, distribution pinned
+   statistically vs the non-speculative sampler (rejection sampling
+   preserves the distribution; the sample path legitimately differs);
+4. **the throttle** — accept-rate EWMA scales K down and falls back to
+   plain decode below the floor (adversarial traffic never drafts
+   forever), probes re-engage it;
+5. **shape discipline** — warm speculative bursts compile NOTHING under
+   the retrace sentinel, and the fully-idle engine resets its live-width
+   high-water (the ISSUE 15 satellite) with the sentinel still green
+   across the reset.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.config.schema import Config
+
+from tests._helpers import tiny_llama_config
+
+
+def _serve_cfg(*, alibi=False, llama=False, n_slots=3, block_size=4,
+               max_seq=64, max_new=16, budget=2048, prefix=False,
+               spec=True, k=4, accept_floor=0.3, probe_ticks=64,
+               draft_budget=64) -> Config:
+    if llama:
+        cfg = tiny_llama_config(n_kv_heads=2)
+    else:
+        cfg = Config()
+        cfg.model.d_model = 32
+        cfg.model.n_layers = 2
+        cfg.model.n_heads = 4
+        cfg.model.vocab_size = 96
+        cfg.model.attn_impl = "xla"
+        cfg.model.compute_dtype = "float32"
+        cfg.model.alibi = alibi
+        cfg.model.learned_pos_emb = not alibi
+    cfg.model.max_seq_len = max_seq
+    cfg.photon.serve.n_slots = n_slots
+    cfg.photon.serve.block_size = block_size
+    cfg.photon.serve.max_new_tokens = max_new
+    cfg.photon.serve.prefill_token_budget = budget
+    cfg.photon.serve.prefix_cache = prefix
+    sp = cfg.photon.serve.speculative
+    sp.enabled = spec
+    sp.k = k
+    sp.accept_floor = accept_floor
+    sp.probe_ticks = probe_ticks
+    sp.draft_budget = draft_budget
+    return cfg.validate()
+
+
+def _offline_greedy(cfg, params, prompt, n):
+    from photon_tpu.models.decode import make_cached_generate_fn
+
+    buf = np.zeros((1, len(prompt) + n), np.int32)
+    buf[0, : len(prompt)] = prompt
+    fn = make_cached_generate_fn(cfg.model, params)
+    t, _ = fn.many(jnp.asarray(buf), jnp.asarray([len(prompt)], np.int32), n)
+    return [int(x) for x in np.asarray(t)[0, len(prompt):]]
+
+
+class _FixedDrafter:
+    """Deterministic test drafter: pops pre-scripted drafts per slot
+    (empty once the script runs out)."""
+
+    def __init__(self, script=None):
+        self.script = dict(script or {})  # slot -> list of draft lists
+        self.began: dict[int, list[int]] = {}
+        self.observed: dict[int, list[int]] = {}
+
+    def begin(self, slot, prompt):
+        self.began[slot] = list(prompt)
+        self.observed.setdefault(slot, [])
+
+    def observe(self, slot, tokens):
+        self.observed[slot].extend(tokens)
+
+    def end(self, slot):
+        self.began.pop(slot, None)
+
+    def propose(self, slot, k):
+        q = self.script.get(slot)
+        return list(q.pop(0))[:k] if q else []
+
+
+# ---------------------------------------------------------------------------
+# 1. the generalized grid: bitwise vs K sequential single-token steps
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["mpt-wpe", "mpt-alibi", "llama-gqa"])
+def test_spec_grid_bitexact_vs_sequential_steps(name):
+    """The satellite pin, at the cache layer: TWO decode rows each
+    carrying 3 tokens through ONE ``mixed_chunk_step(n_spec=4)`` call —
+    with a THIRD slot's prompt chunk in the same batch — produce
+    per-position logits and live KV bytes bitwise equal to three
+    sequential single-token calls (chunk riding the first)."""
+    from photon_tpu.models.mpt import init_params
+    from photon_tpu.serve.cache import (
+        BlockAllocator, init_paged_state, install_row, mixed_chunk_step,
+    )
+
+    cfg = _serve_cfg(alibi=name == "mpt-alibi", llama=name == "llama-gqa",
+                     max_seq=32)
+    mc = cfg.model
+    params = init_params(mc, seed=4)
+    bs = cfg.photon.serve.block_size
+    m = -(-mc.max_seq_len // bs)
+    B = 3
+    rng = np.random.default_rng(1)
+    prompts = [list(map(int, rng.integers(1, mc.vocab_size, 6)))
+               for _ in range(2)]
+    chunk_prompt = list(map(int, rng.integers(1, mc.vocab_size, 5)))
+
+    def fresh():
+        alloc = BlockAllocator(B * m)
+        pst = init_paged_state(mc, B, B * m, bs, m)
+        for slot in range(B):
+            ids = alloc.alloc(m)
+            row = np.full(m, B * m, np.int32)
+            row[:m] = ids
+            pst = install_row(pst, jnp.int32(slot), jnp.asarray(row),
+                              jnp.int32(0))
+        return pst
+
+    def prefill(pst, slot, toks):
+        n = len(toks)
+        tq = 8
+        tk = np.zeros((B, tq), np.int32)
+        ps = np.zeros((B, tq), np.int32)
+        qv = np.zeros((B, tq), bool)
+        eo = np.zeros(B, np.int32)
+        tk[slot, :n] = toks
+        ps[slot, :n] = np.arange(n)
+        qv[slot, :n] = True
+        eo[slot] = n - 1
+        la = pst.lengths
+        la = np.asarray(la).copy()
+        la[slot] = n
+        lg, pst = mixed_chunk_step(
+            params, pst, jnp.asarray(tk), jnp.asarray(ps), jnp.asarray(qv),
+            jnp.asarray(eo), jnp.asarray(la), jnp.int32(slot), mc,
+            n_ctx=4, has_chunk=True)
+        return np.asarray(lg), pst
+
+    def decode_call(pst, lengths, last, *, chunk_seg=None, chunk_pos=0):
+        """One classic step: decode cols for slots 0/1 (+ optional chunk
+        for slot 2); returns (logits [B, V], new state)."""
+        has_chunk = chunk_seg is not None
+        tq = 8 if has_chunk else 1
+        tk = np.zeros((B, tq), np.int32)
+        ps = np.zeros((B, tq), np.int32)
+        qv = np.zeros((B, tq), bool)
+        eo = np.zeros(B, np.int32)
+        la = lengths.copy()
+        for s in (0, 1):
+            tk[s, 0] = last[s]
+            ps[s, 0] = lengths[s]
+            qv[s, 0] = True
+            la[s] += 1
+        if has_chunk:
+            cn = len(chunk_seg)
+            tk[2, :cn] = chunk_seg
+            ps[2, :cn] = np.arange(chunk_pos, chunk_pos + cn)
+            qv[2, :cn] = True
+            la[2] = chunk_pos + cn
+        lg, pst = mixed_chunk_step(
+            params, pst, jnp.asarray(tk), jnp.asarray(ps), jnp.asarray(qv),
+            jnp.asarray(eo), jnp.asarray(la), jnp.int32(2), mc,
+            n_ctx=4, has_chunk=has_chunk)
+        return np.asarray(lg), pst, la
+
+    # ---- path A: 3 sequential single-token steps (slot 2 chunks on
+    # step 1, then sits idle mid-prefill) ---------------------------------
+    pstA = fresh()
+    lgs = []
+    for s, p in enumerate(prompts):
+        lg, pstA = prefill(pstA, s, p)
+        lgs.append(lg[s])
+    lengths = np.asarray([len(prompts[0]), len(prompts[1]), 0], np.int32)
+    last = np.asarray([int(np.argmax(lgs[0])), int(np.argmax(lgs[1])), 0],
+                      np.int32)
+    seq_logits = []
+    chunk1 = chunk_prompt[:3]  # slot 2 mid-prefill: 3 of 5 prompt tokens
+    lg, pstA, lengths = decode_call(pstA, lengths, last, chunk_seg=chunk1)
+    seq_logits.append(lg)
+    last = np.asarray([int(np.argmax(lg[0])), int(np.argmax(lg[1])), 0])
+    for _ in range(2):
+        lg, pstA, lengths = decode_call(pstA, lengths, last)
+        seq_logits.append(lg)
+        last = np.asarray([int(np.argmax(lg[0])), int(np.argmax(lg[1])), 0])
+
+    # ---- path B: ONE spec grid step with the same 3 tokens per row ------
+    pstB = fresh()
+    lgsB = []
+    for s, p in enumerate(prompts):
+        lg, pstB = prefill(pstB, s, p)
+        lgsB.append(lg[s])
+    np.testing.assert_array_equal(lgs[0], lgsB[0])
+    lengths = np.asarray([len(prompts[0]), len(prompts[1]), 0], np.int32)
+    feed = np.zeros((B, 3), np.int32)
+    for s in (0, 1):
+        feed[s, 0] = int(np.argmax(lgsB[s]))
+        feed[s, 1] = int(np.argmax(seq_logits[0][s]))
+        feed[s, 2] = int(np.argmax(seq_logits[1][s]))
+    n_spec = 4  # pow2 bucket of 3 — includes a PAD column
+    tq = 8  # chunk bucket dominates
+    tk = np.zeros((B, tq), np.int32)
+    ps = np.zeros((B, tq), np.int32)
+    qv = np.zeros((B, tq), bool)
+    eo = np.zeros(B, np.int32)
+    la = lengths.copy()
+    for s in (0, 1):
+        tk[s, :3] = feed[s]
+        ps[s, :3] = lengths[s] + np.arange(3)
+        qv[s, :3] = True
+        la[s] += 3
+    cn = len(chunk1)
+    tk[2, :cn] = chunk1
+    ps[2, :cn] = np.arange(cn)
+    qv[2, :cn] = True
+    la[2] = cn
+    lgB, pstB = mixed_chunk_step(
+        params, pstB, jnp.asarray(tk), jnp.asarray(ps), jnp.asarray(qv),
+        jnp.asarray(eo), jnp.asarray(la), jnp.int32(2), mc,
+        n_ctx=4, has_chunk=True, n_spec=n_spec)
+    lgB = np.asarray(lgB)  # [B, n_spec, V]
+
+    for i in range(3):
+        for s in (0, 1):
+            np.testing.assert_array_equal(
+                seq_logits[i][s], lgB[s, i],
+                err_msg=f"{name}: slot {s} verified column {i}")
+    # live KV bytes identical (only the trash block may differ — pad
+    # columns and idle rows write there)
+    ckA, ckB = np.asarray(pstA.cache_k), np.asarray(pstB.cache_k)
+    trash = ckA.shape[0] - 1
+    np.testing.assert_array_equal(ckA[:trash], ckB[:trash])
+
+
+@pytest.mark.parametrize("name", ["mpt-wpe", "llama-gqa"])
+def test_engine_spec_step_matches_sequential_engine(name):
+    """The same pin at the ENGINE layer: spec_step with all-accept drafts
+    (+ a mid-prefill batch-mate's chunk in the same call) emits exactly
+    the sequential engine's tokens and leaves identical decode state
+    (subsequent plain steps continue bitwise-identically)."""
+    from photon_tpu.models.mpt import init_params
+    from photon_tpu.serve.engine import PagedEngine
+
+    cfg = _serve_cfg(llama=name == "llama-gqa", budget=3)
+    params = init_params(cfg.model, seed=4)
+    rng = np.random.default_rng(7)
+    p0 = list(map(int, rng.integers(1, cfg.model.vocab_size, 5)))
+    p1 = list(map(int, rng.integers(1, cfg.model.vocab_size, 7)))
+    p2 = list(map(int, rng.integers(1, cfg.model.vocab_size, 6)))
+
+    def boot(engine):
+        engine.begin(0, p0, 10)
+        engine.begin(1, p1, 10)
+        while engine.pending_tokens(0) or engine.pending_tokens(1):
+            s = 0 if engine.pending_tokens(0) else 1
+            engine.mixed_step((s, engine.pending_tokens(s)),
+                              include_decode=False)
+        engine.begin(2, p2, 8)  # slot 2 stays mid-prefill during the step
+
+    # sequential reference: chunk + 3 single-token steps
+    ref = PagedEngine(cfg, params)
+    boot(ref)
+    ref_toks = {0: [], 1: []}
+    out, em = ref.mixed_step((2, 3))  # chunk rides step 1
+    for s in (0, 1):
+        ref_toks[s].append(int(out[s]))
+    for _ in range(2):
+        out, em = ref.mixed_step(None)
+        for s in (0, 1):
+            ref_toks[s].append(int(out[s]))
+
+    # speculative: ONE step whose drafts are the (known-good) refs
+    eng = PagedEngine(cfg, params)
+    boot(eng)
+    drafts = {s: ref_toks[s][:2] for s in (0, 1)}
+    out2, n_em = eng.spec_step((2, 3), drafts)
+    for s in (0, 1):
+        assert int(n_em[s]) == 3  # 2 accepted drafts + the bonus
+        assert [int(x) for x in out2[s, :3]] == ref_toks[s]
+    assert eng.pending_tokens(2) == len(p2) - 3  # the chunk advanced too
+    np.testing.assert_array_equal(eng._lengths[:2], ref._lengths[:2])
+    # continued PLAIN decode stays identical: the accepted drafts' KV is
+    # bitwise the sequential path's
+    for _ in range(3):
+        a, _ = ref.mixed_step(None)
+        b, _ = eng.mixed_step(None)
+        np.testing.assert_array_equal(a[:2], b[:2])
+
+
+def test_spec_rejection_rolls_back_and_stays_bitexact():
+    """An ADVERSARIAL drafter (garbage drafts every step) must cost
+    nothing but wasted verify columns: the emitted greedy stream still
+    equals the oracle, rejected positions roll back (lengths advance by
+    exactly the accepted count), and later steps overwrite the stale
+    bytes invisibly."""
+    from photon_tpu.models.mpt import init_params
+    from photon_tpu.serve.engine import PagedEngine
+
+    cfg = _serve_cfg(n_slots=2)
+    params = init_params(cfg.model, seed=4)
+    p = [5, 9, 2, 7]
+    want = _offline_greedy(cfg, params, p, 8)
+    eng = PagedEngine(cfg, params)
+    eng.begin(0, p, 8)
+    while eng.pending_tokens(0):
+        eng.mixed_step((0, eng.pending_tokens(0)), include_decode=False)
+    got = []
+    while len(got) < 8:
+        bad = [(want[len(got)] + 1) % cfg.model.vocab_size] * 3  # never match
+        out, n_em = eng.spec_step(None, {0: bad})
+        n = int(n_em[0])
+        assert n == 1  # first draft rejected → bonus token only
+        assert int(eng._lengths[0]) == len(p) + len(got) + 1  # rolled back
+        got.extend(int(x) for x in out[0, :n])
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# 2. greedy end-to-end bit-exactness through the batcher
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["mpt-wpe", "mpt-alibi", "llama-gqa"])
+def test_spec_serving_bitexact_with_offline(name):
+    """Acceptance pin: the speculative batcher (n-gram drafter, chunked
+    prefill budget 3, prefix cache ON, recycled blocks) completes every
+    greedy request EXACTLY like the offline oracle."""
+    from photon_tpu.models.mpt import init_params
+    from photon_tpu.serve.engine import PagedEngine
+    from photon_tpu.serve.scheduler import ContinuousBatcher
+
+    cfg = _serve_cfg(alibi=name == "mpt-alibi", llama=name == "llama-gqa",
+                     prefix=True, budget=3)
+    params = init_params(cfg.model, seed=4)
+    engine = PagedEngine(cfg, params)
+    batcher = ContinuousBatcher(
+        engine, max_queue=16, prefill_token_budget=3,
+        speculative=cfg.photon.serve.speculative,
+    ).start()
+    rng = np.random.default_rng(5)
+    shared = list(map(int, rng.integers(1, cfg.model.vocab_size, 8)))
+    try:
+        for i in range(6):
+            suf = list(map(int, rng.integers(1, cfg.model.vocab_size,
+                                             int(rng.integers(1, 6)))))
+            p = (shared + suf) if i % 2 else suf
+            got = batcher.submit(p, 12).result(timeout=120)
+            assert got == _offline_greedy(cfg, params, p, 12), p
+        assert batcher._spec.drafted > 0  # drafting genuinely happened
+        assert batcher._spec.accepted > 0
+        assert engine.n_active == 0
+    finally:
+        batcher.close()
+
+
+def test_spec_eos_and_max_new_mid_burst():
+    """EOS landing INSIDE an emission burst truncates the stream exactly
+    like the non-speculative engine (the burst's tail is discarded), and
+    max_new_tokens is never exceeded."""
+    from photon_tpu.models.mpt import init_params
+    from photon_tpu.serve.engine import PagedEngine
+    from photon_tpu.serve.scheduler import ContinuousBatcher
+
+    cfg = _serve_cfg()
+    params = init_params(cfg.model, seed=4)
+    p = [3, 3, 8, 1]
+    ref = _offline_greedy(cfg, params, p, 12)
+    eos = ref[4]  # truncate mid-stream; the cycle guarantee: it recurs
+    want = ref[: ref.index(eos) + 1]
+    engine = PagedEngine(cfg, params)
+    batcher = ContinuousBatcher(
+        engine, max_queue=4, speculative=cfg.photon.serve.speculative,
+    ).start()
+    try:
+        got = batcher.submit(p, 12, eos_id=eos).result(timeout=120)
+        assert got == want
+        got2 = batcher.submit(p, 5, eos_id=-1).result(timeout=120)
+        assert got2 == ref[:5]  # max_new cap honored mid-burst
+    finally:
+        batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# 3. temperature: determinism + distribution
+# ---------------------------------------------------------------------------
+
+
+def test_spec_temperature_reproducible_and_distribution_pinned():
+    """Seeded temperature streams under speculation are REPRODUCIBLE
+    (same seed + same traffic → same completion), and the per-position
+    sampling distribution matches the non-speculative sampler
+    statistically: rejection sampling against the drafter's point-mass
+    proposal preserves the model's distribution exactly, so the FIRST
+    sampled token's histogram over many seeds must agree between the
+    speculative and non-speculative engines."""
+    from photon_tpu.models.mpt import init_params
+    from photon_tpu.serve.engine import PagedEngine
+
+    cfg = _serve_cfg(n_slots=1, max_new=8)
+    params = init_params(cfg.model, seed=4)
+    p = [5, 9, 2, 7]
+    eng = PagedEngine(cfg, params)  # ONE engine: jit caches shared
+
+    def run(spec_drafts, seed, n=4, temp=0.8):
+        eng.begin(0, p, 8, temperature=temp, seed=seed)
+        while eng.pending_tokens(0):
+            eng.mixed_step((0, eng.pending_tokens(0)), include_decode=False)
+        toks = [int(eng._last[0])]
+        while len(toks) < n:
+            if spec_drafts:
+                out, n_em = eng.spec_step(None, {0: [toks[-1]] * 2})
+                toks.extend(int(x) for x in out[0, : int(n_em[0])])
+            else:
+                out, _ = eng.mixed_step(None)
+                toks.append(int(out[0]))
+        eng.evict(0)
+        return toks[:n]
+
+    # reproducibility: identical runs → identical streams
+    assert run(True, seed=11) == run(True, seed=11)
+    assert run(False, seed=11) == run(False, seed=11)
+    # the prefill emission is drawn BEFORE any draft is tested → bitwise
+    # the non-speculative sampler's token, per seed
+    for s in range(12):
+        assert run(True, seed=s, n=1) == run(False, seed=s, n=1)
+
+
+def test_nondrafting_temp_row_is_batchmate_independent():
+    """A seeded temperature row that carries NO drafts must emit the
+    SAME stream whether its step ran as the classic program (alone) or
+    as a speculative grid (a greedy batch-mate drafted) — the verify
+    loop keeps the classic split(k)-per-emission chain, so batch-mates'
+    draft schedules can never perturb a non-drafting row."""
+    from photon_tpu.models.mpt import init_params
+    from photon_tpu.serve.engine import PagedEngine
+
+    cfg = _serve_cfg(n_slots=2, max_new=16)
+    params = init_params(cfg.model, seed=4)
+    p_temp, p_greedy = [5, 9, 2, 7], [3, 3, 8, 1]
+
+    def boot(eng, with_mate):
+        eng.begin(0, p_temp, 12, temperature=0.8, seed=17)
+        while eng.pending_tokens(0):
+            eng.mixed_step((0, eng.pending_tokens(0)), include_decode=False)
+        if with_mate:
+            eng.begin(1, p_greedy, 12)
+            while eng.pending_tokens(1):
+                eng.mixed_step((1, eng.pending_tokens(1)),
+                               include_decode=False)
+
+    # alone: classic n_spec == 1 steps
+    a = PagedEngine(cfg, params)
+    boot(a, with_mate=False)
+    alone = [int(a._last[0])]
+    for _ in range(6):
+        out, _ = a.mixed_step(None)
+        alone.append(int(out[0]))
+
+    # with a drafting batch-mate: every step is a speculative grid, but
+    # slot 0 itself never drafts
+    b = PagedEngine(cfg, params)
+    boot(b, with_mate=True)
+    mate_drafts = [int(b._last[1])] * 3  # content irrelevant — slot 1's
+    together = [int(b._last[0])]
+    while len(together) < 7:
+        out, n_em = b.spec_step(None, {1: list(mate_drafts)})
+        together.extend(int(x) for x in out[0, : int(n_em[0])])
+    assert together[:7] == alone
+
+
+def test_spec_temperature_rejection_distribution():
+    """The rejection-sampling identity itself, pinned directly on
+    _verify_rows: with a point-mass proposal at draft d, P(emit = t)
+    must equal the model's softmax p(t) — accept contributes p(d) at d,
+    the residual contributes p(t) elsewhere. Empirical over many keys on
+    a fixed 4-token distribution."""
+    from photon_tpu.serve.engine import _verify_rows
+
+    n = 4000  # one BATCHED _verify_rows call: 4000 independent rows
+    logits = jnp.broadcast_to(
+        jnp.log(jnp.asarray([0.5, 0.25, 0.15, 0.10], jnp.float32)), (n, 4)
+    )
+    grid = jnp.stack([logits, logits], axis=1)  # [n, 2, V]
+    tokens = jnp.broadcast_to(jnp.asarray([7, 0], jnp.int32), (n, 2))
+    temps = jnp.ones(n, jnp.float32)
+    emit = jnp.ones(n, bool)
+    n_valid = jnp.full(n, 2, jnp.int32)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(n, dtype=jnp.uint32))
+    out, n_em, _ = _verify_rows(grid, tokens, temps, keys, emit, n_valid, 2)
+    first = np.asarray(out)[:, 0]
+    freq = np.bincount(first, minlength=4)[:4] / n
+    np.testing.assert_allclose(freq, [0.5, 0.25, 0.15, 0.10], atol=0.03)
+
+
+# ---------------------------------------------------------------------------
+# 4. the drafter + throttle
+# ---------------------------------------------------------------------------
+
+
+def test_ngram_drafter_prompt_lookup_and_cycles():
+    from photon_tpu.serve.draft import NGramDrafter
+
+    d = NGramDrafter(max_ngram=3, min_ngram=1)
+    d.begin(0, [1, 2, 3, 4, 1, 2, 3])
+    # trailing [1,2,3] matched at the prompt head → continuation 4, then
+    # self-extension continues the match: 4,1,2,3 → ...
+    assert d.propose(0, 4) == [4, 1, 2, 3]
+    d.observe(0, [9])
+    assert d.propose(0, 2) == []  # ...3,9 never seen: nothing to propose
+    d.observe(0, [9, 9])
+    # a period-1 cycle still yields a FULL-depth draft (self-extension)
+    assert d.propose(0, 4) == [9, 9, 9, 9]
+    d.end(0)
+    assert d.propose(0, 4) == []  # ended slots propose nothing
+
+
+def test_spec_controller_throttle_and_probe():
+    from photon_tpu.serve.draft import SpecController
+
+    c = SpecController(k_max=4, accept_floor=0.3, ewma_alpha=0.5,
+                       probe_ticks=3)
+    assert c.next_k() == 4  # optimistic start
+    c.observe(4, 4)
+    assert c.k_effective() == 4
+    c.observe(4, 2)  # ewma 1.0 → 0.75
+    assert c.next_k() == 3  # proportional throttle
+    for _ in range(6):
+        c.observe(4, 0)
+    assert c.ewma < 0.3
+    assert c.k_effective() == 0  # pure read: below floor = plain decode
+    assert c.next_k() == 0  # ticks 1, 2 ...
+    assert c.next_k() == 0
+    assert c.next_k() == 1  # tick 3: the probe
+    assert c.next_k() == 0  # probe clock reset
+    # a run of accepted probes climbs back over the floor
+    for _ in range(4):
+        c.observe(1, 1)
+    assert c.k_effective() >= 1
+    # stats read k_effective without advancing the probe clock
+    c2 = SpecController(k_max=2, accept_floor=0.9, probe_ticks=2)
+    c2.observe(10, 0)
+    for _ in range(10):
+        assert c2.k_effective() == 0  # pure — no probe ever fires here
+    assert c2.next_k() == 0
+    assert c2.next_k() == 1
+
+
+def test_adversarial_traffic_auto_throttles_to_plain_decode():
+    """Incompressible traffic (garbage drafts rejected every step) drives
+    the EWMA under the floor: drafting stops (spec_k 0), the engine runs
+    the CLASSIC compiled step again, and completions stay oracle-exact
+    throughout."""
+    from photon_tpu.models.mpt import init_params
+    from photon_tpu.serve.engine import PagedEngine
+    from photon_tpu.serve.scheduler import ContinuousBatcher
+    from photon_tpu.utils.profiling import SERVE_SPEC_K
+
+    cfg = _serve_cfg(probe_ticks=0)  # once off, stays off
+    params = init_params(cfg.model, seed=4)
+    engine = PagedEngine(cfg, params)
+    # a drafter whose guesses are ALWAYS wrong: propose vocab-shifted
+    # copies of the last emission
+    vocab = cfg.model.vocab_size
+
+    class BadDrafter(_FixedDrafter):
+        def __init__(self):
+            super().__init__()
+            self.last: dict[int, int] = {}
+
+        def observe(self, slot, tokens):
+            self.last[slot] = tokens[-1]
+
+        def propose(self, slot, k):
+            t = self.last.get(slot, 1)
+            return [(t + 17 + i) % vocab or 1 for i in range(k)]
+
+    batcher = ContinuousBatcher(
+        engine, max_queue=8, speculative=cfg.photon.serve.speculative,
+        drafter=BadDrafter(),
+    ).start()
+    rng = np.random.default_rng(3)
+    try:
+        for i in range(3):
+            p = list(map(int, rng.integers(1, vocab, 5)))
+            got = batcher.submit(p, 12).result(timeout=120)
+            assert got == _offline_greedy(cfg, params, p, 12)
+        st = batcher.stats()
+        assert st[SERVE_SPEC_K] == 0.0  # throttled off
+        assert batcher._spec.ewma < 0.3
+        # drafting really stopped: a fresh request moves drafted no more
+        before = batcher._spec.drafted
+        p = list(map(int, rng.integers(1, vocab, 5)))
+        assert batcher.submit(p, 8).result(timeout=120) \
+            == _offline_greedy(cfg, params, p, 8)
+        assert batcher._spec.drafted == before
+    finally:
+        batcher.close()
+
+
+def test_spec_moe_silently_ineligible():
+    """MoE: batch-global expert capacity breaks per-row purity — the
+    batcher quietly serves plain decode (the prefix-cache precedent)."""
+    from photon_tpu.serve.scheduler import ContinuousBatcher
+
+    class _McEng:
+        class mc:
+            mlp = "moe"
+
+    cfg = _serve_cfg()
+    b = ContinuousBatcher(_McEng(), speculative=cfg.photon.serve.speculative)
+    assert b._spec is None and b._drafter is None
+
+
+# ---------------------------------------------------------------------------
+# 5. config validation + KPI registry
+# ---------------------------------------------------------------------------
+
+
+def test_speculative_config_validation():
+    for field, bad in (("k", 0), ("k", 33), ("draft_budget", 0),
+                       ("min_ngram", 0), ("max_ngram", 0),
+                       ("accept_floor", 1.5), ("ewma_alpha", 0.0),
+                       ("probe_ticks", -1)):
+        cfg = _serve_cfg()
+        setattr(cfg.photon.serve.speculative, field, bad)
+        with pytest.raises(ValueError, match="speculative"):
+            cfg.validate()
+    cfg = _serve_cfg()
+    cfg.photon.serve.speculative.min_ngram = 2
+    cfg.photon.serve.speculative.max_ngram = 1  # min > max
+    with pytest.raises(ValueError, match="speculative"):
+        cfg.validate()
+
+
+def test_spec_kpis_registered_and_recorded():
+    from photon_tpu.models.mpt import init_params
+    from photon_tpu.serve.engine import PagedEngine
+    from photon_tpu.serve.scheduler import ContinuousBatcher
+    from photon_tpu.utils.profiling import (
+        SERVE_SPEC_ACCEPT_RATE,
+        SERVE_SPEC_ACCEPTED,
+        SERVE_SPEC_DRAFTED,
+        SERVE_SPEC_K,
+        SERVE_SPEC_STEPS,
+        registered_metric_names,
+    )
+
+    names = registered_metric_names()
+    for n in (SERVE_SPEC_DRAFTED, SERVE_SPEC_ACCEPTED, SERVE_SPEC_STEPS,
+              SERVE_SPEC_ACCEPT_RATE, SERVE_SPEC_K):
+        assert n in names
+    cfg = _serve_cfg()
+    params = init_params(cfg.model, seed=4)
+    batcher = ContinuousBatcher(
+        PagedEngine(cfg, params), max_queue=4,
+        speculative=cfg.photon.serve.speculative,
+    ).start()
+    try:
+        batcher.submit([5, 9, 2], 8).result(timeout=120)
+        st = batcher.stats()
+        assert st[SERVE_SPEC_DRAFTED] >= st[SERVE_SPEC_ACCEPTED] >= 0
+        assert 0.0 <= st[SERVE_SPEC_ACCEPT_RATE] <= 1.0
+    finally:
+        batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# 6. shape discipline: the sentinel over spec bursts + the idle reset
+# ---------------------------------------------------------------------------
+
+
+def test_ctx_width_resets_when_fully_idle():
+    """The ISSUE 15 satellite: one long request must not inflate every
+    later batch's attention width for the daemon's lifetime — a fully
+    idle engine drops the high-water back to 1 (mid-flight it stays
+    monotone), and the compiled-width cache makes the re-warm free."""
+    from photon_tpu.models.mpt import init_params
+    from photon_tpu.serve.engine import PagedEngine
+
+    cfg = _serve_cfg(n_slots=2, max_seq=64, spec=False)
+    params = init_params(cfg.model, seed=4)
+    eng = PagedEngine(cfg, params)
+    long_p = list(range(1, 41))  # 40 tokens → 10+ blocks → width 16
+    eng.begin(0, long_p, 8)
+    while eng.pending_tokens(0):
+        eng.mixed_step((0, eng.pending_tokens(0)), include_decode=False)
+    assert eng.attn_stats()["ctx_blocks"] >= 16
+    eng.begin(1, [1, 2, 3], 4)  # short batch-mate pays the wide walk...
+    while eng.pending_tokens(1):
+        eng.mixed_step((1, eng.pending_tokens(1)), include_decode=False)
+    eng.evict(0)
+    assert eng.attn_stats()["ctx_blocks"] >= 16  # ...monotone while live
+    eng.evict(1)
+    assert eng.attn_stats()["ctx_blocks"] == 1.0  # fully idle: reset
+    eng.begin(0, [4, 5, 6], 4)  # 7 tokens = 2 blocks: runs at width 2,
+    while eng.pending_tokens(0):  # not the dead giant's 16
+        eng.mixed_step((0, eng.pending_tokens(0)), include_decode=False)
+    assert eng.attn_stats()["ctx_blocks"] == 2.0
+
+
+def test_retrace_sentinel_green_spec_bursts_and_idle_reset():
+    """Warm speculative bursts — every (chunk, n_spec, live-width) bucket
+    compiled — then a guarded burst AND a full-idle high-water reset AND
+    a re-warmed burst compile NOTHING. Driven synchronously (this test
+    owns the driver phases) so the step sequence is deterministic."""
+    from photon_tpu.analysis import runtime as lint_rt
+    from photon_tpu.models.mpt import init_params
+    from photon_tpu.serve.engine import PagedEngine
+    from photon_tpu.serve.scheduler import ContinuousBatcher
+
+    cfg = _serve_cfg(n_slots=2, max_seq=32, accept_floor=0.0, budget=4)
+    params = init_params(cfg.model, seed=4)
+    engine = PagedEngine(cfg, params)
+    batcher = ContinuousBatcher(
+        engine, max_queue=8, prefill_token_budget=4,
+        speculative=cfg.photon.serve.speculative,
+    )
+    # warm every pow2 verify width a k=4 drafter can mint (n_spec 2/4/8
+    # with the bonus column; 1 is the classic step) at every ctx width
+    # the bursts below will touch
+    def burst():
+        reqs = [batcher.submit([7, 3, 7, 3, 7, 3], 10),
+                batcher.submit([2, 8, 2, 8, 2], 8)]
+        while not all(r.finished for r in reqs):
+            batcher._admit_phase()
+            batcher._step_phase()
+        return reqs
+
+    def warm_spec_widths():
+        engine.begin(0, [1, 2, 3], 4)
+        while engine.pending_tokens(0):
+            engine.mixed_step((0, engine.pending_tokens(0)),
+                              include_decode=False)
+        for d in ([5], [5, 6, 7], [5, 6, 7, 1, 2, 3, 4]):
+            engine.spec_step(None, {0: list(d)})
+        engine.evict(0)
+
+    warm_spec_widths()
+    burst()
+    burst()  # second pass: post-reset traffic re-hits warmed buckets
+    with lint_rt.retrace_guard(steady=True) as sentinel:
+        burst()
+        assert engine.n_active == 0  # burst drained → high-water reset
+        assert engine.attn_stats()["ctx_blocks"] == 1.0
+        burst()  # the re-warm after the reset compiles nothing
+    assert sentinel.violations == []
+    assert batcher._spec.drafted > 0
+    batcher.close()
