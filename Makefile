@@ -1,7 +1,7 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test test-all bench bench-host bench-telemetry bench-collective bench-zero1 bench-ragged bench-compare chaos chaos-collective telemetry-smoke serve-smoke spec-smoke adapters-smoke lint lint-tests native clean
+.PHONY: test test-all bench bench-host bench-telemetry bench-collective bench-zero1 bench-ragged bench-compare chaos chaos-collective telemetry-smoke serve-smoke spec-smoke fleet-smoke adapters-smoke lint lint-tests native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
@@ -119,6 +119,15 @@ spec-smoke: lint
 		tests/test_speculative.py -q -m "slow or not slow"
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --speculative
 
+# fleet router (ISSUE 16): placement policy + control plane + failover
+# suite, then the bench gate — affinity routing must beat random on both
+# aggregate tokens/s and mean TTFT over 4 emulated replicas, and a
+# mid-traffic replica kill must drop zero requests on the survivors
+fleet-smoke: lint
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_router.py -q -m "slow or not slow"
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --fleet
+
 # per-cohort LoRA personalization plane (ISSUE 13): the train-side suite
 # (config validation, LoRA payload algebra, fused multi-cohort reduction
 # vs the per-cohort host oracle at off + pinned q8 bound, federated
@@ -144,7 +153,8 @@ adapters-smoke: lint
 chaos: lint
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_chaos.py tests/test_membership.py tests/test_tcp_driver.py \
-		tests/test_checkpoint.py tests/test_shm.py -q -m chaos
+		tests/test_checkpoint.py tests/test_shm.py tests/test_router.py \
+		-q -m chaos
 
 # elastic collective rounds (ISSUE 8): stage-deadline units + the
 # SIGKILL-mid-collective e2es (gang reconfiguration, quorum, host-fallback

@@ -2113,6 +2113,197 @@ def speculative_serving_report(n_requests: int = 4, n_slots: int = 4,
         return None
 
 
+def fleet_serving_report(n_replicas: int = 4, n_tenants: int = 4,
+                         n_requests: int = 16, seed: int = 0) -> dict | None:
+    """Fleet router locality win (ISSUE 16): affinity routing vs random
+    over N emulated replicas, plus a mid-traffic replica kill.
+
+    **Affinity vs random.** N in-process replicas (full engine + HTTP
+    frontend + control agent each; only the process boundary is
+    emulated), each with a CAPPED prefix cache (~2 shared prefixes) and a
+    2-page adapter pool — the cache capacity model that makes placement
+    matter: the fleet can hold every tenant's state, but no single
+    replica can. Traffic is ``n_tenants`` cohorts, each request that
+    tenant's 384-token system prefix plus a fresh suffix (the 90 %-shared
+    regime from the prefix bench), plus an anonymous shared-prefix
+    stream. Affinity mode pins tenant→replica 1:1 and rendezvous-routes
+    anonymous traffic, so every request lands where its KV blocks and
+    adapter pages already live; random mode scatters the SAME request
+    lists, thrashing each capped LRU with up-to-``n_tenants+1`` prefixes.
+    ABBA-ordered best-of-2 per mode; affinity must win BOTH aggregate
+    tokens/s and mean TTFT (exit gate — strictly better, not parity).
+
+    **Replica kill.** On a fresh affinity fleet: route traffic, SIGKILL
+    one replica (both planes go silent, nothing drains), keep routing —
+    every post-kill request must complete on the survivors (connect
+    failures reroute before any response byte). ``dropped_on_survivors``
+    is exit-gated at 0."""
+    try:
+        from concurrent.futures import ThreadPoolExecutor
+
+        import numpy as np
+
+        from photon_tpu.adapters.lora import (
+            init_adapter_arrays, spec_from_params,
+        )
+        from photon_tpu.config.schema import Config
+        from photon_tpu.models.mpt import init_params
+        from photon_tpu.serve.fleet import InProcessFleet
+
+        tenants = [f"t{i}" for i in range(n_tenants)]
+
+        def mk_cfg() -> Config:
+            cfg = Config()
+            cfg.model.d_model = 64
+            cfg.model.n_layers = 3
+            cfg.model.n_heads = 4
+            cfg.model.max_seq_len = 512
+            cfg.model.vocab_size = 64
+            cfg.model.attn_impl = "xla"
+            cfg.model.compute_dtype = "float32"
+            cfg.photon.serve.n_slots = 2
+            cfg.photon.serve.block_size = 16
+            cfg.photon.serve.max_new_tokens = 8
+            cfg.photon.serve.prefix_cache = True
+            # ~2 tenants' 24-block prefixes per replica: the fleet holds
+            # all the state, one replica can't — placement decides hits
+            cfg.photon.serve.prefix_cache_blocks = 56
+            cfg.photon.adapters.enabled = True
+            cfg.photon.adapters.rank = 4
+            cfg.photon.adapters.pool_size = 2
+            cfg.photon.adapters.cohorts = {t: [] for t in tenants}
+            flt = cfg.photon.serve.fleet
+            flt.enabled = True
+            flt.replicas = n_replicas
+            flt.report_poll_s = 0.1
+            flt.report_timeout_s = 1.0
+            return cfg.validate()
+
+        cfg = mk_cfg()
+        params = init_params(cfg.model, seed=4)
+        spec = spec_from_params(params, cfg.photon.adapters.rank,
+                                cfg.photon.adapters.alpha,
+                                tuple(cfg.photon.adapters.targets))
+        bank = {t: init_adapter_arrays(spec, seed=i + 1)[1]
+                for i, t in enumerate(tenants)}
+        rng = np.random.default_rng(seed)
+        prefixes = {t: list(map(int, rng.integers(1, 64, 384)))
+                    for t in tenants}
+        anon_prefix = list(map(int, rng.integers(1, 64, 384)))
+
+        def make_requests() -> list[dict]:
+            """Round-robin over tenants + an anonymous shared-prefix
+            stream — every request ~390-400 prompt tokens + 4 new."""
+            out = []
+            for i in range(n_requests):
+                suf = list(map(int, rng.integers(1, 64,
+                                                 int(rng.integers(6, 17)))))
+                if i % (n_tenants + 1) == n_tenants:
+                    out.append({"tokens": anon_prefix + suf,
+                                "max_new_tokens": 4})
+                else:
+                    t = tenants[i % (n_tenants + 1)]
+                    out.append({"tokens": prefixes[t] + suf,
+                                "max_new_tokens": 4, "cohort": t})
+            return out
+
+        def post(port: int, payload: dict) -> dict:
+            import http.client as hc
+
+            c = hc.HTTPConnection("127.0.0.1", port, timeout=300)
+            try:
+                c.request("POST", "/generate",
+                          body=json.dumps(payload).encode(),
+                          headers={"Content-Type": "application/json"})
+                r = c.getresponse()
+                body = r.read()
+                if r.status != 200:
+                    raise RuntimeError(f"HTTP {r.status}")
+                return json.loads(body)
+            finally:
+                c.close()
+
+        def run_traffic(port: int, requests: list[dict]) -> dict:
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=8) as ex:
+                outs = list(ex.map(lambda p: post(port, p), requests))
+            wall = time.perf_counter() - t0
+            tokens = sum(o["n_generated"] for o in outs)
+            return {
+                "tokens_per_s": round(tokens / wall, 2),
+                "ttft_mean_s": round(
+                    sum(o["ttft_s"] for o in outs) / len(outs), 5),
+                "wall_s": round(wall, 4),
+            }
+
+        fleets = {}
+        for mode in ("affinity", "random"):
+            fl = InProcessFleet(cfg, params, mode=mode, adapter_bank=bank)
+            fl.start(timeout=120)
+            fleets[mode] = fl
+        # 1:1 tenant→replica pins (the operator pre-pin path): each
+        # replica's cache and adapter pool serves exactly one tenant
+        fleets["affinity"].router.policy.pins = {
+            t: f"replica{i}" for i, t in enumerate(tenants)}
+        try:
+            # warmup: compiles (shared in-process cache) + cache warm
+            warm = make_requests()
+            for mode in ("affinity", "random"):
+                run_traffic(fleets[mode].router.port, warm)
+            lists = [make_requests(), make_requests()]
+            runs = {"affinity": [], "random": []}
+            for mode, reqs in (("affinity", lists[0]), ("random", lists[0]),
+                               ("random", lists[1]), ("affinity", lists[1])):
+                runs[mode].append(run_traffic(fleets[mode].router.port, reqs))
+            best = {m: min(rs, key=lambda r: r["wall_s"])
+                    for m, rs in runs.items()}
+        finally:
+            for fl in fleets.values():
+                fl.close()
+
+        # replica kill on a fresh affinity fleet
+        fl = InProcessFleet(cfg, params, adapter_bank=bank)
+        dropped = 0
+        try:
+            port = fl.start(timeout=120)
+            run_traffic(port, make_requests()[: n_replicas])
+            fl.kill_replica("replica1")
+            post_kill = [dict(r) for r in make_requests()
+                         if r.get("cohort") != "t1"][:8]
+            for r in post_kill:
+                try:
+                    post(port, r)
+                except Exception:  # noqa: BLE001 — a failure IS a drop here
+                    dropped += 1
+            survivors = len(fl.router.live_replicas())
+        finally:
+            fl.close()
+
+        out = {
+            "n_replicas": n_replicas, "n_tenants": n_tenants,
+            "n_requests": n_requests,
+            "shared_prefix_tokens": 384,
+            "affinity": best["affinity"], "random": best["random"],
+            "tokens_per_s_gain": (
+                round(best["affinity"]["tokens_per_s"]
+                      / best["random"]["tokens_per_s"], 3)
+                if best["random"]["tokens_per_s"] else None),
+            "ttft_gain": (
+                round(best["random"]["ttft_mean_s"]
+                      / best["affinity"]["ttft_mean_s"], 3)
+                if best["affinity"]["ttft_mean_s"] > 0 else None),
+            "replica_kill": {
+                "requests_after_kill": 8,
+                "dropped_on_survivors": dropped,
+                "live_after_kill": survivors,
+            },
+        }
+        return out
+    except Exception as e:  # noqa: BLE001 — never cost the round its numbers
+        log(f"fleet serving report failed: {type(e).__name__}: {e}")
+        return None
+
+
 # ---------------------------------------------------------------------------
 # Device-collective aggregation plane (ISSUE 7; lands in the BENCH_*.json)
 # ---------------------------------------------------------------------------
@@ -2740,6 +2931,12 @@ def _spec_templated_tps(parsed: dict) -> float | None:
                          "tokens_per_s"))
 
 
+def _fleet_affinity_tps(parsed: dict) -> float | None:
+    """Affinity-routed aggregate tokens/s across the emulated fleet (the
+    regime the router exists for, ISSUE 16)."""
+    return _dig(parsed, ("serving_fleet", "affinity", "tokens_per_s"))
+
+
 #: gated headline numbers, (extractor, label, platform_sensitive). Higher
 #: is better for all; a drop past the threshold exits nonzero.
 _COMPARE_GATES = (
@@ -2748,6 +2945,7 @@ _COMPARE_GATES = (
     (_ragged_low_occ_tps, "serving_ragged_low_occ_tokens_per_s", False),
     (_spec_templated_tps, "serving_speculative_templated_tokens_per_s",
      False),
+    (_fleet_affinity_tps, "serving_fleet_affinity_tokens_per_s", False),
     # fused-grouped-reduction win over K sequential reductions (ISSUE 13)
     (lambda p: _dig(p, ("adapters", "fused_speedup")),
      "adapters_fused_speedup", False),
@@ -3232,6 +3430,12 @@ def run(platform: str) -> None:
         if sd is not None:
             out["serving_speculative"] = sd
             emit(out)
+        # fleet router (ISSUE 16): affinity vs random placement over N
+        # emulated replicas + the replica-kill zero-drop run
+        ft = fleet_serving_report()
+        if ft is not None:
+            out["serving_fleet"] = ft
+            emit(out)
 
     # device-collective aggregation plane (own child interpreter — the
     # emulated 8-device CPU mesh must exist before jax initializes): flat
@@ -3407,6 +3611,15 @@ def main() -> int:
                          "unless speculative beats baseline on templated "
                          "traffic AND does not regress (>= 0.9x, drafting "
                          "auto-throttled off) on random traffic")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run only the fleet-router report (N=4 emulated "
+                         "replicas, affinity vs random routing on "
+                         "90%%-shared-prefix + multi-cohort traffic, plus a "
+                         "mid-traffic replica kill) and print "
+                         "{'serving_fleet': ...}; exits nonzero unless "
+                         "affinity beats random on BOTH aggregate tokens/s "
+                         "and mean TTFT and the kill run drops zero "
+                         "requests on survivors")
     ap.add_argument("--adapters", action="store_true",
                     help="per-cohort LoRA plane gate (ISSUE 13): modeled "
                          "adapter wire bytes >= 50x below a full-model "
@@ -3499,6 +3712,22 @@ def main() -> int:
         throttled = (sd["random"]["speculative"].get("spec_k_final") == 0.0)
         return 0 if (t_gain and t_gain > 1.0
                      and r_gain and r_gain >= 0.9 and throttled) else 1
+    if args.fleet:
+        # the ISSUE 16 gate alone (make fleet-smoke): routing on state
+        # locality must beat random placement on BOTH headline numbers —
+        # strictly, not parity — and replica death must drop nothing on
+        # the survivors
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        ft = fleet_serving_report()
+        emit({"serving_fleet": ft})
+        if ft is None:
+            return 1
+        tps_gain = ft.get("tokens_per_s_gain")
+        ttft_gain = ft.get("ttft_gain")
+        kill = ft.get("replica_kill") or {}
+        return 0 if (tps_gain and tps_gain > 1.0
+                     and ttft_gain and ttft_gain > 1.0
+                     and kill.get("dropped_on_survivors") == 0) else 1
     if args.adapters:
         # CPU-jax only, fresh backend (the emulated client mesh must be
         # configured before jax initializes — the in-run bench reaches

@@ -90,6 +90,7 @@ class FaultInjector:
             "store_slow": 0, "store_partial": 0, "store_bitflip": 0,
             "store_read_slow": 0, "store_read_partial": 0,
             "store_read_bitflip": 0, "crash": 0, "nan_delta": 0,
+            "replica_kill": 0,
         }
         # total CORRUPTING store faults (partial/bitflip, reads + writes)
         # fired, bounded by cfg.store_fault_max (0 = unlimited) — "corrupt
@@ -190,6 +191,32 @@ class FaultInjector:
         self._fired("nan_delta", server_round=server_round, cid=cid)
         return True
 
+    # -- fleet replica kill (ISSUE 16) -----------------------------------
+    def replica_kill_plan(self, requests_routed: int,
+                          live_replicas: list[str]) -> str | None:
+        """The replica id to SIGKILL now, or None. Fires exactly once, when
+        the router's cumulative placement count reaches
+        ``replica_kill_after_requests``; ``replica_kill_id`` pins the
+        victim, else the seeded stream picks one of ``live_replicas``
+        (sorted — the draw must not depend on caller ordering).
+        Deterministic — no probability draw: the fleet chaos e2e needs one
+        death at one known point in the traffic."""
+        c = self.cfg
+        n = int(getattr(c, "replica_kill_after_requests", 0))
+        if not n or self.counts["replica_kill"] or requests_routed < n:
+            return None
+        want = str(getattr(c, "replica_kill_id", ""))
+        if want:
+            victim = want if want in live_replicas else None
+        else:
+            victim = (self.rng.choice(sorted(live_replicas))
+                      if live_replicas else None)
+        if victim is None:
+            return None
+        self._fired("replica_kill", replica=victim,
+                    requests_routed=requests_routed)
+        return victim
+
     # -- node crash ------------------------------------------------------
     def maybe_crash(self, phase: str, server_round: int = 0, node_id: str = "") -> None:
         c = self.cfg
@@ -281,4 +308,9 @@ def validate_chaos_config(cfg) -> None:
         raise ValueError(
             f"chaos.store_fault_max must be >= 0 (0 = unlimited), got "
             f"{cfg.store_fault_max}"
+        )
+    if getattr(cfg, "replica_kill_after_requests", 0) < 0:
+        raise ValueError(
+            f"chaos.replica_kill_after_requests must be >= 0 (0 = off), got "
+            f"{cfg.replica_kill_after_requests}"
         )

@@ -350,6 +350,13 @@ class ChaosConfig:
     # the deterministic trigger for the health plane's NaN sentinel e2e.
     nan_delta_round: int = 0
     nan_delta_cid: int = -1  # -1 = every client serving that round
+    # fleet replica-kill (ISSUE 16): SIGKILL one serving replica after the
+    # router has placed exactly this many requests (0 = off) — the
+    # deterministic mid-traffic death the fleet e2e asserts survivors ride
+    # out with zero drops. Same no-probability-draw discipline as
+    # nan_delta_round.
+    replica_kill_after_requests: int = 0
+    replica_kill_id: str = ""  # "" = seeded pick among the live fleet
 
 
 @dataclass
@@ -422,6 +429,49 @@ class SpeculativeConfig:
     #: while throttled off, probe with one drafted step every N ticks
     #: (0 = never probe: once off, stays off)
     probe_ticks: int = 64
+
+
+@dataclass
+class FleetConfig:
+    """N-replica scale-out serving behind one router (ISSUE 16,
+    ``serve/router.py`` + ``serve/fleet.py``).
+
+    OFF by default (the serve-plane opt-in discipline). Enabled,
+    ``python -m photon_tpu.serve --fleet`` spawns ``replicas`` engine
+    daemons — each today's single-process daemon unchanged, on its own
+    ephemeral port — and a router tier that places each ``/generate`` on
+    state locality: the prompt's chain-hash block-prefix digest
+    (``serve/prefix.py``) lands shared-system-prompt traffic where its KV
+    blocks already live, cohorts pin sticky to replicas so an adapter
+    pool stays hot for its tenant set, and power-of-two-choices on live
+    queue depth covers everything else. The router↔replica control plane
+    is the CRC-framed ``federation/tcp.py`` stack (HELLO / liveness /
+    load reports / drain / rolling hot-swap); the data plane is the
+    existing HTTP frontend, proxied.
+    """
+
+    enabled: bool = False
+    replicas: int = 2  # engine daemons behind the router (N >= 1)
+    host: str = "127.0.0.1"
+    port: int = 0  # router data-plane HTTP port; 0 = bind-ephemeral
+    control_port: int = 0  # router↔replica TCP control plane; 0 = ephemeral
+    # chain-hash blocks of the prompt used as the prefix-affinity routing
+    # key (0 = prefix affinity off). The LAST digest of the first
+    # ``prefix_affinity_blocks`` full blocks identifies the whole shared
+    # prefix — rendezvous-hashed over live replicas so one prefix's
+    # traffic converges on one replica's cache without a routing table.
+    prefix_affinity_blocks: int = 4
+    # sticky cohort → replica pinning (re-pins to a survivor on death);
+    # off, cohort requests fall through to prefix/p2c like any other
+    cohort_affinity: bool = True
+    # control-plane cadence: one poll = one load-report query per replica,
+    # doubling as the liveness ping (a missed report walks the
+    # LivenessTracker ladder exactly like a missed ping)
+    report_poll_s: float = 0.5
+    report_timeout_s: float = 2.0  # per-poll reply deadline
+    # alternate replicas tried when a proxy CONNECT fails before any
+    # response byte (after bytes flow the error surfaces to the client)
+    route_retries: int = 2
 
 
 @dataclass
@@ -500,6 +550,9 @@ class ServeConfig:
     # decoding row may carry up to k draft tokens through the mixed grid,
     # verified in one step — greedy bit-exact, auto-throttled by accept rate
     speculative: SpeculativeConfig = field(default_factory=SpeculativeConfig)
+    # N-replica scale-out behind an affinity router (ISSUE 16): each
+    # replica is this daemon unchanged; the router owns placement only
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
 
 #: dense-projection module names LoRA can target (the per-layer matmuls
@@ -883,6 +936,32 @@ class Config:
         if srv.hotswap_poll_s <= 0:
             raise ValueError(
                 f"serve.hotswap_poll_s must be > 0, got {srv.hotswap_poll_s}"
+            )
+        flt = srv.fleet
+        if flt.replicas < 1:
+            raise ValueError(
+                f"serve.fleet.replicas must be >= 1, got {flt.replicas}"
+            )
+        for pname in ("port", "control_port"):
+            pv = getattr(flt, pname)
+            if not 0 <= pv <= 65535:
+                raise ValueError(
+                    f"serve.fleet.{pname} must be in [0, 65535], got {pv}"
+                )
+        if flt.prefix_affinity_blocks < 0:
+            raise ValueError(
+                f"serve.fleet.prefix_affinity_blocks must be >= 0 (0 = no "
+                f"prefix affinity), got {flt.prefix_affinity_blocks}"
+            )
+        if flt.report_poll_s <= 0 or flt.report_timeout_s <= 0:
+            raise ValueError(
+                f"serve.fleet needs report_poll_s > 0 and report_timeout_s "
+                f"> 0, got {flt.report_poll_s}/{flt.report_timeout_s}"
+            )
+        if flt.route_retries < 0:
+            raise ValueError(
+                f"serve.fleet.route_retries must be >= 0, got "
+                f"{flt.route_retries}"
             )
         spec = srv.speculative
         if not 1 <= spec.k <= 32:

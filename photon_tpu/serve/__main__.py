@@ -44,7 +44,18 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--port", type=int, default=None)
     ap.add_argument("--tokenizer", default=None,
                     help="enable 'text' prompts (e.g. byte-fallback, gpt2)")
+    # fleet mode (ISSUE 16): a replica daemon dials the router's control
+    # plane and reports its bound data port there — N replicas with
+    # --port 0 race no ports and need no port bookkeeping at spawn time
+    ap.add_argument("--fleet-connect", default=None, metavar="HOST:PORT",
+                    help="dial this fleet router control plane and serve "
+                         "as one replica of its fleet")
+    ap.add_argument("--replica-id", default=None,
+                    help="stable replica id for --fleet-connect "
+                         "(cohort pins + liveness key on the router)")
     args = ap.parse_args(argv)
+    if bool(args.fleet_connect) != bool(args.replica_id):
+        ap.error("--fleet-connect and --replica-id go together")
 
     from photon_tpu import telemetry
     from photon_tpu.checkpoint import FileStore
@@ -114,8 +125,21 @@ def main(argv: list[str] | None = None) -> None:
         ).start()
         frontend.watcher = watcher
     port = frontend.start()
+    agent = None
+    if args.fleet_connect:
+        from photon_tpu.serve.fleet import ReplicaAgent
+
+        agent = ReplicaAgent(
+            args.fleet_connect, args.replica_id,
+            batcher=batcher, frontend=frontend, watcher=watcher,
+            drain_timeout_s=sc.drain_timeout_s,
+        ).start()
     print(json.dumps({
         "serving": f"http://{sc.host}:{port}",
+        # explicit bound port (satellite: --port 0 spawners parse this
+        # instead of splitting the URL)
+        "port": port,
+        "replica_id": args.replica_id,
         "round": engine.loaded_round,
         "model": cfg.model.name,
         "n_slots": engine.n_slots,
@@ -149,6 +173,10 @@ def main(argv: list[str] | None = None) -> None:
         # on its own once the batcher reports draining)
         if watcher is not None:
             watcher.close()
+        if agent is not None:
+            # leave the fleet first: the router stops routing here before
+            # the drain begins, so survivors absorb the traffic
+            agent.stop()
         if graceful.is_set():
             frontend.mark_draining()
             batcher.drain(sc.drain_timeout_s)

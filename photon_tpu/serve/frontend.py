@@ -111,6 +111,9 @@ class ServeFrontend:
                         "completed": fe.batcher.completed,
                         "rejected": fe.batcher.rejected,
                         "swaps": fe.batcher.swaps,
+                        # the router's p2c signal (ISSUE 16): queue length +
+                        # live-slot fraction + draining, one lock snapshot
+                        "load": fe.batcher.load_report(),
                         "kpis": serve_history_kpis(fe.batcher.history),
                     }
                     prefix = eng.prefix_stats()

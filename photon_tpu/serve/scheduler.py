@@ -409,6 +409,18 @@ class ContinuousBatcher:
         with self._lock:
             return len(self._queue)
 
+    def load_report(self) -> dict:
+        """Cheap point-in-time load signal (ISSUE 16): queue length,
+        live-slot fraction, draining flag — the router's power-of-two-
+        choices input, served on /healthz and over the fleet control
+        plane. One lock acquisition, no engine work."""
+        with self._lock:
+            return {
+                "queue_depth": len(self._queue),
+                "live_slot_frac": len(self._running) / self.engine.n_slots,
+                "draining": self._draining or self._stop,
+            }
+
     def spec_stats(self) -> dict | None:
         """Speculative-decoding counters for /healthz (None when off).
         Lock-snapshotted like :meth:`stats` — the HTTP handler thread

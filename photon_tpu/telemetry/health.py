@@ -51,6 +51,7 @@ from typing import Any
 from photon_tpu.utils.profiling import (
     ALERT_ADAPTER_COHORT,
     ALERT_DEGRADED_ROUNDS,
+    ALERT_FLEET_REPLICA_DEAD,
     ALERT_HBM_GROWTH,
     ALERT_NONFINITE,
     ALERT_QUEUE_SATURATION,
@@ -64,7 +65,7 @@ FAILING = "failing"
 _LEVEL = {OK: 0, DEGRADED: 1, FAILING: 2}
 
 #: every plane /statusz reports, present even before its first check
-PLANES = ("federation", "collective", "serve", "store")
+PLANES = ("federation", "collective", "serve", "store", "fleet")
 
 
 @dataclasses.dataclass
@@ -323,6 +324,17 @@ class HealthMonitor:
         resume, failed async write): the run survived, the storage didn't."""
         return self.alert(
             ALERT_STORE_CORRUPT, plane="store", severity=DEGRADED, **attrs
+        )
+
+    def note_fleet_replica_dead(self, **attrs: Any) -> Alert:
+        """Fleet-plane degradation (ISSUE 16): the liveness ladder declared
+        a serving replica dead — the fleet serves on at (N-1)/N capacity
+        and the dead replica's cohorts re-pin to survivors. Degrades (never
+        latches): the router resolves the plane when every tracked replica
+        is live again."""
+        return self.alert(
+            ALERT_FLEET_REPLICA_DEAD, plane="fleet", severity=DEGRADED,
+            **attrs,
         )
 
     def note_cohort_degraded(self, **attrs: Any) -> Alert:

@@ -252,6 +252,35 @@ SERVE_ADAPTER_EVICTIONS = "serve/adapter_evictions_total"
 #: fraction of cohort acquisitions served by a resident page
 SERVE_ADAPTER_HIT_RATE = "serve/adapter_hit_rate"
 
+# -- fleet router (ISSUE 16, serve/router.py + serve/fleet.py) ------------
+# Router-tier KPIs recorded into the router's own History (exported via the
+# same exposition renderer on the router's /metrics):
+#: cumulative /generate requests the router accepted for routing
+ROUTER_REQUESTS_TOTAL = "router/requests_total"
+#: requests placed by the chain-hash prefix-affinity key
+ROUTER_ROUTED_PREFIX = "router/routed_prefix_total"
+#: requests placed by the sticky cohort pin
+ROUTER_ROUTED_COHORT = "router/routed_cohort_total"
+#: requests placed by the power-of-two-choices queue-depth fallback
+ROUTER_ROUTED_P2C = "router/routed_p2c_total"
+#: requests re-placed on a survivor after a connect failure (never after
+#: response bytes started flowing — those surface to the client)
+ROUTER_REROUTES = "router/reroutes_total"
+#: cumulative proxy legs that failed outright (no survivor accepted)
+ROUTER_PROXY_ERRORS = "router/proxy_errors_total"
+#: replicas the liveness ladder currently counts live / suspect / dead
+ROUTER_REPLICAS_LIVE = "router/replicas_live"
+ROUTER_REPLICAS_SUSPECT = "router/replicas_suspect"
+ROUTER_REPLICAS_DEAD = "router/replicas_dead"
+#: cumulative cohort pins moved off a dead replica onto a survivor
+ROUTER_COHORT_REPINS = "router/cohort_repins_total"
+# Fleet-supervisor KPIs (serve plane vocabulary — the replicas are serve
+# daemons; the supervisor aggregates):
+#: replica daemons the supervisor currently manages
+SERVE_FLEET_REPLICAS = "serve/fleet_replicas"
+#: cumulative one-at-a-time rolling hot-swap passes across the fleet
+SERVE_FLEET_ROLLING_SWAPS = "serve/fleet_rolling_swaps_total"
+
 # -- run-health observatory instruments (ISSUE 10, telemetry/metrics.py) --
 # Histogram instruments on the serve plane (typed-metric hub, NOT History
 # KPIs: a latest-value gauge can't show a distribution):
@@ -315,6 +344,14 @@ EVENT_HOTSWAP_SWAPPED = "hotswap/swapped"
 #: the watcher skipped a candidate round (corrupt manifest, failing
 #: federation health, or a poll landing during drain) — attrs say which
 EVENT_HOTSWAP_SKIPPED = "hotswap/skipped"
+#: a replica registered with the fleet router (HELLO + fleet_report)
+EVENT_FLEET_REPLICA_UP = "fleet/replica_up"
+#: the liveness ladder declared a replica dead; its cohorts re-pin
+EVENT_FLEET_REPLICA_DEAD = "fleet/replica_dead"
+#: a cohort's sticky pin moved to a survivor (attrs: cohort, from, to)
+EVENT_FLEET_COHORT_REPIN = "fleet/cohort_repin"
+#: one replica finished its leg of a rolling hot-swap pass
+EVENT_FLEET_ROLLING_SWAP = "fleet/rolling_swap"
 
 # -- structured alert kinds (telemetry/health.py, ISSUE 10) ---------------
 # Health watchers emit these as events (same registry discipline) AND
@@ -335,6 +372,9 @@ ALERT_HBM_GROWTH = "alert/hbm_growth"
 #: an adapter cohort lost every member for a round (personalization
 #: plane degradation — scoped to that cohort only, ISSUE 13)
 ALERT_ADAPTER_COHORT = "alert/adapter_cohort"
+#: a fleet replica went dead on the liveness ladder (ISSUE 16): the
+#: fleet degrades by 1/N and its cohorts re-pin to survivors
+ALERT_FLEET_REPLICA_DEAD = "alert/fleet_replica_dead"
 
 #: dynamic metric-name families the registry can't enumerate statically:
 #: per-strategy-state norms (``server/{state_key}_norm``,
@@ -343,8 +383,8 @@ DYNAMIC_METRIC_PATTERNS: tuple[str, ...] = (r"server/[A-Za-z0-9_]+_norm",)
 
 
 def registered_metric_names() -> frozenset:
-    """Every ``server/*`` / ``client/*`` / ``serve/*`` name declared as a
-    module constant (the static half of the registry; see
+    """Every ``server/*`` / ``client/*`` / ``serve/*`` / ``router/*`` name
+    declared as a module constant (the static half of the registry; see
     DYNAMIC_METRIC_PATTERNS)."""
     import sys
 
@@ -355,7 +395,7 @@ def registered_metric_names() -> frozenset:
         if isinstance(v, str)
         and not k.startswith("_")
         and (v.startswith("server/") or v.startswith("client/")
-             or v.startswith("serve/"))
+             or v.startswith("serve/") or v.startswith("router/"))
     )
 
 

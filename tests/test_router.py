@@ -1,0 +1,402 @@
+"""Fleet router (ISSUE 16): affinity placement, control plane, failover.
+
+Four layers of contract:
+
+1. the placement policy in isolation — rendezvous stability (churn moves
+   only the dead replica's keys), cohort pins stick and re-pin on death,
+   prefix keys follow the ``serve/prefix.py`` chain property, p2c prefers
+   the lower queue depth;
+2. the load signal — ``ContinuousBatcher.load_report`` is cheap and
+   truthful, and ``/healthz`` serves it;
+3. routed == single-engine: greedy completions through a 3-replica fleet
+   are BIT-EXACT against the offline contiguous decoder (routing changes
+   placement, never outputs);
+4. failover — SIGKILL-shaped replica death walks the liveness ladder,
+   re-pins cohorts, degrades the fleet health plane, and drops zero
+   requests on survivors; the seeded chaos injector reproduces the same
+   death mid-traffic (`chaos` marker).
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.config.schema import Config
+from photon_tpu.serve.router import (
+    AffinityRouter,
+    NoReplicasError,
+    ReplicaState,
+    rendezvous_pick,
+)
+
+
+def _fleet_cfg(*, replicas=3, n_slots=2, block_size=4, max_seq=32,
+               max_new=8, prefix_blocks=2) -> Config:
+    cfg = Config()
+    cfg.model.d_model = 32
+    cfg.model.n_layers = 2
+    cfg.model.n_heads = 4
+    cfg.model.vocab_size = 96
+    cfg.model.attn_impl = "xla"
+    cfg.model.compute_dtype = "float32"
+    cfg.model.max_seq_len = max_seq
+    cfg.photon.serve.n_slots = n_slots
+    cfg.photon.serve.block_size = block_size
+    cfg.photon.serve.max_new_tokens = max_new
+    flt = cfg.photon.serve.fleet
+    flt.enabled = True
+    flt.replicas = replicas
+    flt.prefix_affinity_blocks = prefix_blocks
+    flt.report_poll_s = 0.1
+    flt.report_timeout_s = 1.0
+    return cfg.validate()
+
+
+def _params(cfg):
+    from photon_tpu.models.mpt import init_params
+
+    return init_params(cfg.model, seed=4)
+
+
+def _post_generate(port, payload, timeout=60):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        c.request("POST", "/generate", body=json.dumps(payload).encode(),
+                  headers={"Content-Type": "application/json"})
+        r = c.getresponse()
+        return r.status, json.loads(r.read())
+    finally:
+        c.close()
+
+
+def _offline_greedy(cfg, params, prompt, n):
+    """Oracle: the contiguous cached decoder, one row (test_serve idiom)."""
+    from photon_tpu.models.decode import make_cached_generate_fn
+
+    buf = np.zeros((1, len(prompt) + n), np.int32)
+    buf[0, : len(prompt)] = prompt
+    fn = make_cached_generate_fn(cfg.model, params)
+    t, _ = fn.many(jnp.asarray(buf), jnp.asarray([len(prompt)], np.int32), n)
+    return [int(x) for x in np.asarray(t)[0, len(prompt):]]
+
+
+# ---------------------------------------------------------------------------
+# 1. placement policy in isolation
+# ---------------------------------------------------------------------------
+
+
+def test_rendezvous_stable_and_minimal_churn():
+    live = [f"r{i}" for i in range(5)]
+    keys = [f"key{i}".encode() for i in range(64)]
+    before = {k: rendezvous_pick(k, live) for k in keys}
+    # deterministic: same inputs, same winners
+    assert before == {k: rendezvous_pick(k, live) for k in keys}
+    # removing one replica moves ONLY the keys that lived on it
+    dead = "r2"
+    shrunk = [r for r in live if r != dead]
+    for k, old in before.items():
+        new = rendezvous_pick(k, shrunk)
+        if old != dead:
+            assert new == old, "churn moved a key off a surviving replica"
+        else:
+            assert new in shrunk
+    with pytest.raises(NoReplicasError):
+        rendezvous_pick(b"x", [])
+
+
+def test_p2c_prefers_lower_queue_depth():
+    r = AffinityRouter(block_size=4, prefix_affinity_blocks=0)
+    loads = {
+        "a": ReplicaState("a", queue_depth=7, live_slot_frac=1.0),
+        "b": ReplicaState("b", queue_depth=0, live_slot_frac=0.0),
+    }
+    for _ in range(16):
+        rid, reason = r.route([1, 2], None, ["a", "b"], loads)
+        assert (rid, reason) == ("b", "p2c")
+
+
+def test_cohort_pin_sticks_and_repins_on_death():
+    r = AffinityRouter(block_size=4)
+    live = ["r0", "r1", "r2"]
+    first, reason = r.route([1] * 16, "tenant-a", live, {})
+    assert reason == "cohort"
+    for _ in range(8):
+        assert r.route([9] * 16, "tenant-a", live, {})[0] == first
+    # death: the pin moves to a survivor and sticks there
+    survivors = [x for x in live if x != first]
+    moved = r.repin_dead(first, survivors)
+    assert moved and moved[0][0] == "tenant-a" and moved[0][1] in survivors
+    assert r.route([1] * 16, "tenant-a", survivors, {})[0] == moved[0][1]
+    # empty-string cohort is NOT a cohort (anonymous traffic)
+    rid, reason = r.route([1, 2], "", live, {"r0": ReplicaState("r0")})
+    assert reason == "p2c"
+
+
+def test_prefix_key_follows_chain_property():
+    r = AffinityRouter(block_size=4, prefix_affinity_blocks=2)
+    assert r.prefix_key(None) is None
+    assert r.prefix_key([1, 2, 3]) is None  # shorter than one block
+    base = [7, 1, 2, 3, 9, 9, 9, 9]
+    # same first prefix_affinity_blocks * block_size tokens -> same key,
+    # regardless of the tail
+    k1 = r.prefix_key(base + [5, 5, 5])
+    k2 = r.prefix_key(base + [6, 6, 6, 6, 6])
+    assert k1 == k2 and k1 is not None
+    # a different first block -> a different key
+    assert r.prefix_key([8] + base[1:]) != k1
+    live = ["r0", "r1", "r2", "r3"]
+    routed = {r.route(base + [i], None, live, {})[0] for i in range(8)}
+    assert len(routed) == 1, "shared-prefix traffic must converge"
+    assert r.route(base, None, live, {})[1] == "prefix"
+
+
+def test_random_mode_bypasses_affinity():
+    import random
+
+    r = AffinityRouter(block_size=4, mode="random", rng=random.Random(0))
+    live = ["r0", "r1", "r2", "r3"]
+    picks = {r.route([1] * 16, "tenant-a", live, {})[0] for _ in range(64)}
+    assert len(picks) > 1, "random mode must spread even cohort traffic"
+    assert r.route([1] * 16, "tenant-a", live, {})[1] == "random"
+    assert not r.pins
+
+
+def test_fleet_config_validation():
+    for attr, bad in [("replicas", 0), ("port", 70000), ("control_port", -1),
+                      ("prefix_affinity_blocks", -1), ("report_poll_s", 0.0),
+                      ("report_timeout_s", -1.0), ("route_retries", -1)]:
+        cfg = Config()
+        setattr(cfg.photon.serve.fleet, attr, bad)
+        with pytest.raises(ValueError, match="fleet"):
+            cfg.validate()
+    assert Config().validate().photon.serve.fleet.replicas == 2
+
+
+def test_registry_covers_router_names():
+    from photon_tpu.utils.profiling import registered_metric_names
+
+    names = registered_metric_names()
+    for expect in ("router/requests_total", "router/routed_prefix_total",
+                   "router/routed_cohort_total", "router/routed_p2c_total",
+                   "router/reroutes_total", "router/proxy_errors_total",
+                   "router/replicas_live", "router/replicas_dead",
+                   "router/cohort_repins_total", "serve/fleet_replicas",
+                   "serve/fleet_rolling_swaps_total"):
+        assert expect in names, expect
+
+
+# ---------------------------------------------------------------------------
+# 2. load signal
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served_fleet():
+    """One 3-replica in-process fleet shared by the e2e tests below (the
+    jax compile cache makes replicas 2..N cheap; one fixture keeps the
+    module inside the tier-1 budget)."""
+    from photon_tpu.serve.fleet import InProcessFleet
+
+    cfg = _fleet_cfg()
+    params = _params(cfg)
+    fleet = InProcessFleet(cfg, params)
+    port = fleet.start(timeout=60)
+    yield cfg, params, fleet, port
+    fleet.close()
+
+
+def test_load_report_is_cheap_and_truthful(served_fleet):
+    _, _, fleet, _ = served_fleet
+    rep = fleet.replicas["replica0"]["batcher"].load_report()
+    assert set(rep) == {"queue_depth", "live_slot_frac", "draining"}
+    assert rep["queue_depth"] == 0
+    assert 0.0 <= rep["live_slot_frac"] <= 1.0
+    assert rep["draining"] is False
+
+
+def test_replica_healthz_serves_load(served_fleet):
+    _, _, fleet, _ = served_fleet
+    fe = fleet.replicas["replica0"]["frontend"]
+    c = http.client.HTTPConnection(fe.host, fe.port, timeout=10)
+    try:
+        c.request("GET", "/healthz")
+        body = json.loads(c.getresponse().read())
+    finally:
+        c.close()
+    assert body["load"]["queue_depth"] == 0
+    assert body["load"]["draining"] is False
+
+
+# ---------------------------------------------------------------------------
+# 3. routing never changes outputs
+# ---------------------------------------------------------------------------
+
+
+def test_routed_greedy_bitexact_vs_single_engine(served_fleet):
+    cfg, params, fleet, port = served_fleet
+    rng = np.random.default_rng(7)
+    shared = list(map(int, rng.integers(1, 96, 8)))  # 2 full routed blocks
+    prompts = [shared + list(map(int, rng.integers(1, 96, rng.integers(2, 6))))
+               for _ in range(5)]
+    prompts.append(list(map(int, rng.integers(1, 96, 3))))  # p2c path
+    for p in prompts:
+        status, out = _post_generate(port, {"tokens": p, "max_new_tokens": 6})
+        assert status == 200
+        assert out["tokens"] == _offline_greedy(cfg, params, p, 6), p
+    st = fleet.router.fleet_status()["fleet"]
+    assert st["routed"]["requests"] >= len(prompts)
+    assert st["routed"]["prefix"] >= 5  # the shared-prefix traffic
+
+
+def test_fleet_status_and_metrics_planes(served_fleet):
+    _, _, fleet, port = served_fleet
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        c.request("GET", "/healthz")
+        body = json.loads(c.getresponse().read())
+        assert body["fleet"]["live"] == 3 and body["fleet"]["dead"] == 0
+        assert set(body["fleet"]["replicas"]) == {
+            "replica0", "replica1", "replica2"}
+        c.request("GET", "/metrics")
+        text = c.getresponse().read().decode()
+    finally:
+        c.close()
+    assert "router_requests_total" in text or "router/requests_total" in text
+
+
+def test_rolling_hotswap_one_replica_at_a_time(served_fleet):
+    _, _, fleet, _ = served_fleet
+
+    windows = {}
+    lock = threading.Lock()
+
+    class _FakeWatcher:
+        def __init__(self, rid):
+            self.rid = rid
+
+        def poll_once(self):
+            t0 = time.monotonic()
+            time.sleep(0.05)
+            with lock:
+                windows[self.rid] = (t0, time.monotonic())
+            return "swapped"
+
+    for rid, rep in fleet.replicas.items():
+        rep["agent"].watcher = _FakeWatcher(rid)
+    try:
+        results = fleet.router.rolling_hotswap(timeout_s=10)
+    finally:
+        for rep in fleet.replicas.values():
+            rep["agent"].watcher = None
+    assert len(results) == 3 and all(r["ok"] and r["swapped"] for r in results)
+    # strictly one replica mid-swap at a time: windows never overlap
+    spans = sorted(windows.values())
+    for (_, end_a), (start_b, _) in zip(spans, spans[1:]):
+        assert end_a <= start_b, "two replicas were mid-swap concurrently"
+    assert fleet.router.rolling_swaps == 1
+
+
+# ---------------------------------------------------------------------------
+# 4. failover
+# ---------------------------------------------------------------------------
+
+
+def _wait_dead(router, rid, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if rid not in router.live_replicas():
+            h = router.tracker.nodes.get(rid)
+            if h is not None and h.state == "dead":
+                return
+        time.sleep(0.05)
+    raise AssertionError(f"{rid} never went dead on the router")
+
+
+def test_replica_death_zero_drops_on_survivors():
+    """SIGKILL-shaped death: the fleet degrades to 2/3, every subsequent
+    request still completes (reroute on connect failure), membership +
+    fleet events fire, cohort pins move off the corpse."""
+    from photon_tpu import telemetry
+    from photon_tpu.config.schema import TelemetryConfig
+    from photon_tpu.serve.fleet import InProcessFleet
+
+    cfg = _fleet_cfg()
+    params = _params(cfg)
+    telemetry.install(TelemetryConfig(enabled=True), scope="fleet-test")
+    fleet = InProcessFleet(cfg, params)
+    try:
+        port = fleet.start(timeout=60)
+        victim = "replica1"
+        # pin a cohort onto the victim so death must re-pin it
+        fleet.router.policy.pins["tenant-a"] = victim
+        fleet.kill_replica(victim)
+        _wait_dead(fleet.router, victim)
+        ok = 0
+        for i in range(6):
+            status, out = _post_generate(
+                port, {"tokens": [1 + i, 2, 3, 4, 5], "max_new_tokens": 4})
+            assert status == 200, f"request {i} dropped after replica death"
+            assert len(out["tokens"]) == 4
+            ok += 1
+        assert ok == 6
+        st = fleet.router.fleet_status()["fleet"]
+        assert st["dead"] == 1 and st["live"] == 2
+        assert st["pins"].get("tenant-a") != victim
+        events = telemetry.drain_events()
+        kinds = [e["kind"] for e in events]
+        assert "membership/transition" in kinds
+        assert "fleet/replica_dead" in kinds
+        assert "fleet/cohort_repin" in kinds
+        dead_ev = next(e for e in events if e["kind"] == "fleet/replica_dead")
+        assert dead_ev["attrs"]["replica"] == victim
+        h = telemetry.health_active()
+        assert h is not None
+        alerts = [a for a in h.alerts if a.kind == "alert/fleet_replica_dead"]
+        assert alerts and alerts[0].attrs["replica"] == victim
+    finally:
+        fleet.close()
+        telemetry.uninstall()
+
+
+@pytest.mark.chaos
+def test_chaos_replica_kill_mid_traffic():
+    """Seeded FaultInjector kills one replica after N routed requests —
+    deterministically, once — and the survivors drop nothing."""
+    from photon_tpu import chaos, telemetry
+    from photon_tpu.config.schema import ChaosConfig, TelemetryConfig
+    from photon_tpu.serve.fleet import InProcessFleet
+
+    cfg = _fleet_cfg()
+    params = _params(cfg)
+    telemetry.install(TelemetryConfig(enabled=True), scope="fleet-chaos")
+    chaos.install(
+        ChaosConfig(enabled=True, seed=77, replica_kill_after_requests=3),
+        scope="fleet",
+    )
+    fleet = InProcessFleet(cfg, params)
+    try:
+        port = fleet.start(timeout=60)
+        for i in range(8):
+            status, out = _post_generate(
+                port, {"tokens": [2 + i, 3, 4, 5, 6], "max_new_tokens": 4})
+            assert status == 200, f"request {i} dropped around the kill"
+            assert len(out["tokens"]) == 4
+        inj = chaos.active()
+        assert inj is not None and inj.counts["replica_kill"] == 1
+        killed = [r for r, rep in fleet.replicas.items() if rep["killed"]]
+        assert len(killed) == 1
+        _wait_dead(fleet.router, killed[0])
+        st = fleet.router.fleet_status()["fleet"]
+        assert st["dead"] == 1 and st["live"] == 2
+        kinds = [e["kind"] for e in telemetry.drain_events()]
+        assert "chaos/replica_kill" in kinds
+        assert "fleet/replica_dead" in kinds
+    finally:
+        fleet.close()
+        chaos.uninstall()
+        telemetry.uninstall()

@@ -461,7 +461,7 @@ def test_registry_constants_are_unique():
     names = [v for k, v in vars(profiling).items()
              if isinstance(v, str) and not k.startswith("_")
              and (v.startswith("server/") or v.startswith("client/")
-                  or v.startswith("serve/"))]
+                  or v.startswith("serve/") or v.startswith("router/"))]
     assert len(names) == len(set(names)), "duplicate KPI constants"
 
 
@@ -473,6 +473,18 @@ def test_registry_covers_serve_names():
     names = registered_metric_names()
     for expect in ("serve/ttft_s", "serve/tokens_per_s", "serve/queue_depth",
                    "serve/slot_occupancy", "serve/evictions", "serve/rejected"):
+        assert expect in names, expect
+
+
+def test_registry_covers_fleet_router_names():
+    """The fleet router's KPI vocabulary (ISSUE 16 satellite) rides the
+    same registry — kpi-lint stays exit-0 for router/* emit sites."""
+    from photon_tpu.utils.profiling import registered_metric_names
+
+    names = registered_metric_names()
+    for expect in ("router/requests_total", "router/reroutes_total",
+                   "router/replicas_live", "serve/fleet_replicas",
+                   "serve/fleet_rolling_swaps_total"):
         assert expect in names, expect
 
 
