@@ -1,7 +1,7 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test test-all bench bench-host bench-telemetry bench-collective bench-zero1 bench-ragged bench-compare chaos chaos-collective telemetry-smoke serve-smoke spec-smoke fleet-smoke adapters-smoke lint lint-tests native clean
+.PHONY: test test-all verify bench bench-host bench-telemetry bench-collective bench-zero1 bench-ragged bench-compare chaos chaos-collective telemetry-smoke serve-smoke spec-smoke fleet-smoke adapters-smoke async-smoke lint lint-tests native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
@@ -12,6 +12,32 @@ test:
 test-all:
 	-$(MAKE) native
 	PALLAS_AXON_POOL_IPS= python -m pytest tests/ -x -q -m "slow or not slow"
+
+# tier-1 in THREE pytest processes. The monolithic `pytest tests/` run
+# segfaults (exit 139) inside an XLA compile on this jax 0.4.37 CPU
+# build once a single interpreter has accumulated ~700 tests' worth of
+# backend state — first observed at test_ragged_attention.py; with that
+# module excluded the fault simply drifts to the next compile-heavy
+# module in collection order (test_serve_prefix.py::
+# test_cached_admission_bitexact_per_step, inside a paged_decode_step
+# scan). Every implicated module passes clean in a fresh interpreter,
+# so the fault is cumulative backend state, not any one test. Until the
+# toolchain moves, the serving-engine family (the heaviest compile tail)
+# and the ragged-attention module each run in their own process; the
+# rest of the suite runs together. All three legs must pass.
+SERVE_TESTS := tests/test_adapter_serve.py tests/test_decode.py \
+	tests/test_hotswap.py tests/test_router.py tests/test_serve.py \
+	tests/test_serve_prefix.py tests/test_speculative.py
+verify:
+	-$(MAKE) native
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+		-m "not slow" --ignore=tests/test_ragged_attention.py \
+		$(foreach f,$(SERVE_TESTS),--ignore=$(f)) \
+		--continue-on-collection-errors -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
+		$(SERVE_TESTS) -q -m "not slow" -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_ragged_attention.py -q -m "not slow" -p no:cacheprovider
 
 bench:
 	-$(MAKE) native
@@ -144,6 +170,22 @@ adapters-smoke: lint
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_adapters.py tests/test_adapter_serve.py -q -m "slow or not slow"
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --adapters
+
+# asynchronous federated rounds (ISSUE 18): the version-clock suite —
+# zero-staleness bit-parity with the synchronous runner (all five server
+# optimizers, fp32 + q8, fused plane + host path), staleness-discount
+# weight math, the max-staleness reject / min-arrivals stall / liveness
+# in-flight-drop ladder, deterministic chaos fit delays, the retrace
+# sentinel over the event loop, and the SIGKILL+4x-skew chaos e2e with
+# the hot-swap watcher consuming streamed versions mid-traffic — then
+# the bench gate: async must reach the sync run's final eval loss
+# strictly faster on the modeled wall clock at 4x induced skew AND the
+# K=cohort zero-staleness run must be bit-identical to sync. Lint
+# preflight first like the other smoke targets.
+async-smoke: lint
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_async_round.py -q -m "slow or not slow"
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --async
 
 # the chaos-marked fault-injection + elasticity suite (incl. the slow
 # SIGKILL/rejoin e2es): deterministic — every test pins

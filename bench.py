@@ -2627,6 +2627,165 @@ def zero1_report(n_clients: int = 2, replica: int = 4,
         return None
 
 
+def async_report(n_clients: int = 4, replica: int = 2, K: int = 2,
+                 skew: float = 4.0, sync_rounds: int = 3,
+                 max_versions: int = 16) -> dict | None:
+    """Asynchronous federated rounds vs the synchronous clock (ISSUE 18
+    tentpole) under induced client skew, on the emulated CPU client mesh.
+    Exit-code gates (``--async`` / ``make async-smoke``):
+
+    - **wall-clock-to-target-loss at 4x skew**: one client runs its fits
+      ``skew``× slower (deterministic chaos ``fit_delay_plan``). The sync
+      round clock pays the straggler every round (round wall = the slowest
+      survivor); the async buffered server (K=2) folds fast-client deltas
+      as they land. Both runs are measured on the same modeled clock
+      (``fit_time_s × delay factor``; async reads it off
+      ``server/async_sim_time``), to the sync run's final eval loss —
+      async must reach it strictly faster;
+    - **zero-staleness parity**: a separate homogeneous run with
+      ``K == n_total`` must produce BIT-IDENTICAL parameters to the sync
+      runner after the same number of rounds — the transitive-oracle pin
+      that makes the sync test suite vouch for the async fold.
+    """
+    try:
+        import tempfile
+
+        import numpy as np
+
+        from photon_tpu.utils.compat import set_cpu_device_count
+
+        set_cpu_device_count(n_clients * replica)
+        import jax
+
+        if jax.device_count() < n_clients * replica:
+            log(f"async report needs {n_clients * replica} devices, "
+                f"have {jax.device_count()} (backend initialized early?)")
+            return None
+        from photon_tpu import chaos
+        from photon_tpu.config.schema import Config
+        from photon_tpu.federation.async_round import AsyncFedRunner
+        from photon_tpu.federation.collective_round import CollectiveFedRunner
+        from photon_tpu.utils.profiling import (
+            ASYNC_SIM_TIME,
+            EVAL_LOSS,
+        )
+
+        def _cfg(save_path: str) -> Config:
+            cfg = Config()
+            cfg.model.d_model = 32
+            cfg.model.n_layers = 2
+            cfg.model.n_heads = 2
+            cfg.model.max_seq_len = 16
+            cfg.model.vocab_size = 64
+            cfg.model.attn_impl = "xla"
+            cfg.model.compute_dtype = "float32"
+            cfg.train.global_batch_size = 4
+            cfg.train.device_microbatch_size = 4
+            cfg.fl.n_total_clients = n_clients
+            cfg.fl.n_clients_per_round = n_clients
+            cfg.fl.local_steps = 2
+            cfg.fl.eval_interval_rounds = 0
+            cfg.fl.strategy_name = "fedavg"
+            cfg.fl.server_learning_rate = 1.0
+            cfg.dataset.synthetic = True
+            cfg.photon.checkpoint = False
+            cfg.photon.comm_stack.collective = True
+            cfg.photon.comm_stack.shm = False
+            cfg.photon.comm_stack.collective_replica = replica
+            cfg.photon.comm_stack.collective_device_optimizer = True
+            cfg.photon.save_path = save_path
+            cfg.run_uuid = "bench-async"
+            return cfg
+
+        tmp = tempfile.mkdtemp(prefix="photon-bench-async-")
+
+        # ---- the skewed race: sync pays the straggler, async doesn't ----
+        def _skewed(cfg: Config) -> Config:
+            cfg.photon.chaos.enabled = True
+            cfg.photon.chaos.fit_delay_factor = skew
+            cfg.photon.chaos.fit_delay_cid = n_clients - 1
+            return cfg
+
+        sync_cfg = _skewed(_cfg(f"{tmp}/sync")).validate()
+        chaos.install(sync_cfg.photon.chaos, scope="bench-async")
+        sync = CollectiveFedRunner(sync_cfg, list(range(n_clients)))
+        sync_losses = []
+        for r in range(1, sync_rounds + 1):
+            sync.run_round(r)
+            sync_losses.append(float(sync.evaluate_round(r)[EVAL_LOSS]))
+        chaos.uninstall()
+        target_loss = sync_losses[-1]
+        # every sync round waits for the slowest cohort member
+        sync_time = sync_rounds * 1.0 * skew
+
+        async_cfg = _skewed(_cfg(f"{tmp}/async"))
+        async_cfg.photon.async_rounds.enabled = True
+        async_cfg.photon.async_rounds.buffer_size = K
+        async_cfg.photon.async_rounds.max_staleness = 4
+        async_cfg.validate()
+        chaos.install(async_cfg.photon.chaos, scope="bench-async")
+        runner = AsyncFedRunner(async_cfg, list(range(n_clients)))
+        runner.run_versions(max_versions, eval_every=1)
+        chaos.uninstall()
+        sims = dict(runner.history.series(ASYNC_SIM_TIME))
+        async_time = None
+        versions_to_target = None
+        for v, loss in runner.history.series(EVAL_LOSS):
+            if v > 0 and loss <= target_loss and v in sims:
+                async_time = sims[v]
+                versions_to_target = v
+                break
+
+        # ---- the parity pin: K = cohort, no skew, bit-identical ---------
+        par_rounds = 2
+        ps_cfg = _cfg(f"{tmp}/par-sync").validate()
+        psync = CollectiveFedRunner(ps_cfg, list(range(n_clients)))
+        for r in range(1, par_rounds + 1):
+            psync.run_round(r)
+        pa_cfg = _cfg(f"{tmp}/par-async")
+        pa_cfg.photon.async_rounds.enabled = True
+        pa_cfg.validate()
+        pasync = AsyncFedRunner(pa_cfg, list(range(n_clients)))
+        pasync.run_versions(par_rounds, eval_every=0)
+        bit_exact = all(
+            np.array_equal(a, b)
+            for a, b in zip(pasync.strategy.current_parameters,
+                            psync.strategy.current_parameters)
+        )
+
+        return {
+            "n_clients": n_clients,
+            "K": K,
+            "skew_factor": skew,
+            "target_loss": round(target_loss, 6),
+            "sync": {
+                "rounds": sync_rounds,
+                "sim_time_to_target": round(sync_time, 3),
+                "losses": [round(x, 6) for x in sync_losses],
+            },
+            "async": {
+                "versions_run": int(runner.version),
+                "versions_to_target": versions_to_target,
+                "sim_time_to_target": (
+                    round(async_time, 3) if async_time is not None else None
+                ),
+                "rejected_total": int(runner.rejected_total),
+                "stalls_total": int(runner.stalls_total),
+                "staleness_max": runner.history.latest(
+                    "server/async_staleness_max"
+                ),
+            },
+            "speedup_to_target": (
+                round(sync_time / async_time, 3)
+                if async_time else 0.0
+            ),
+            "params_bit_exact": bool(bit_exact),
+        }
+    except Exception as e:  # noqa: BLE001 — never cost the round its numbers
+        log(f"async report failed: {type(e).__name__}: {e}")
+        return None
+
+
 def _autotune_validation() -> dict | None:
     """Rank-vs-measure the layout auto-tuner (ISSUE 14b acceptance): on
     each emulated mesh shape, the cost model ranks a candidate set and a
@@ -2952,6 +3111,9 @@ _COMPARE_GATES = (
     # ZeRO-1 per-rank server-state byte reduction (ISSUE 14; ~R at R=4)
     (lambda p: _dig(p, ("zero1", "state_bytes_reduction")),
      "zero1_state_bytes_reduction", False),
+    # async-vs-sync wall-clock-to-target-loss at 4x skew (ISSUE 18)
+    (lambda p: _dig(p, ("async", "speedup_to_target")),
+     "async_speedup_to_target", False),
 )
 
 
@@ -3634,6 +3796,15 @@ def main() -> int:
                          "<= (1/R + eps), the update leg is no worse, params "
                          "stay bit-exact and the tuner's top pick is the "
                          "measured-fastest on >= 2 mesh shapes")
+    ap.add_argument("--async", action="store_true", dest="async_rounds",
+                    help="asynchronous federated rounds gate (ISSUE 18): "
+                         "staleness-bounded buffered server vs the sync "
+                         "round clock at 4x induced client skew on the "
+                         "emulated CPU client mesh; exits nonzero unless "
+                         "async reaches the sync run's final eval loss "
+                         "strictly faster on the modeled wall clock AND "
+                         "the zero-staleness K=cohort run is bit-identical "
+                         "to the synchronous rounds")
     ap.add_argument("--collective", action="store_true",
                     help="run only the device-collective aggregation report "
                          "(flat fp32 vs hierarchical q8 on an emulated CPU "
@@ -3759,6 +3930,18 @@ def main() -> int:
         tuner = zr.get("autotune") or {}
         return 0 if (bytes_ok and wall_ok and zr["params_bit_exact"]
                      and tuner.get("match_all")) else 1
+    if args.async_rounds:
+        # CPU-jax only, fresh backend (emulated client mesh before jax
+        # init). Exit gate (ISSUE 18): wall-clock-to-target-loss at 4x
+        # induced skew — async must strictly beat the sync round clock —
+        # AND the zero-staleness corner must be bit-for-bit the sync run.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        ar_ = async_report()
+        emit({"async": ar_})
+        if ar_ is None:
+            return 1
+        return 0 if (ar_.get("speedup_to_target", 0.0) > 1.0
+                     and ar_.get("params_bit_exact")) else 1
     if args.collective:
         # CPU-jax only, fresh backend — the emulated client mesh must be
         # configured before jax initializes, which is why the in-run bench

@@ -90,7 +90,7 @@ class FaultInjector:
             "store_slow": 0, "store_partial": 0, "store_bitflip": 0,
             "store_read_slow": 0, "store_read_partial": 0,
             "store_read_bitflip": 0, "crash": 0, "nan_delta": 0,
-            "replica_kill": 0,
+            "replica_kill": 0, "fit_delay": 0,
         }
         # total CORRUPTING store faults (partial/bitflip, reads + writes)
         # fired, bounded by cfg.store_fault_max (0 = unlimited) — "corrupt
@@ -190,6 +190,34 @@ class FaultInjector:
             return False
         self._fired("nan_delta", server_round=server_round, cid=cid)
         return True
+
+    # -- per-client fit slowdown (ISSUE 18) -------------------------------
+    def fit_delay_plan(self, cid: int) -> float:
+        """This client's fit-duration slowdown factor (>= 1.0; 1.0 = none).
+
+        Deterministic — no sequential draw: the factor is a pure function
+        of ``(seed, scope, cid)``, independent of hook-call order, so the
+        async runner's induced 4x skew replays identically across runs and
+        across sync-vs-async bench arms. ``fit_delay_cid`` pins the full
+        factor on exactly one client (the "one 4x-slow client" scenario);
+        -1 gives every client a seeded factor in [1, factor].
+        """
+        c = self.cfg
+        factor = float(getattr(c, "fit_delay_factor", 0.0) or 0.0)
+        if factor <= 1.0:
+            return 1.0
+        want = int(getattr(c, "fit_delay_cid", -1))
+        if want >= 0:
+            if cid != want:
+                return 1.0
+            f = factor
+        else:
+            rng = random.Random(
+                _scope_seed(c.seed, f"{self.scope}/fit_delay/{cid}")
+            )
+            f = 1.0 + (factor - 1.0) * rng.random()
+        self._fired("fit_delay", cid=cid, factor=round(f, 4))
+        return f
 
     # -- fleet replica kill (ISSUE 16) -----------------------------------
     def replica_kill_plan(self, requests_routed: int,
@@ -313,4 +341,15 @@ def validate_chaos_config(cfg) -> None:
         raise ValueError(
             f"chaos.replica_kill_after_requests must be >= 0 (0 = off), got "
             f"{cfg.replica_kill_after_requests}"
+        )
+    fd = float(getattr(cfg, "fit_delay_factor", 0.0))
+    if fd != 0.0 and fd < 1.0:
+        raise ValueError(
+            f"chaos.fit_delay_factor must be 0 (off) or >= 1 (a slowdown), "
+            f"got {fd}"
+        )
+    if getattr(cfg, "fit_delay_cid", -1) < -1:
+        raise ValueError(
+            f"chaos.fit_delay_cid must be >= -1 (-1 = seeded per-client), "
+            f"got {cfg.fit_delay_cid}"
         )

@@ -357,6 +357,15 @@ class ChaosConfig:
     # nan_delta_round.
     replica_kill_after_requests: int = 0
     replica_kill_id: str = ""  # "" = seeded pick among the live fleet
+    # deterministic per-client fit slowdown (ISSUE 18): scale a client's
+    # fit duration so heterogeneous-hardware skew is reproducible in the
+    # async bench/tests. 0 = off; >= 1 = the slowdown ceiling. With
+    # ``fit_delay_cid`` >= 0 exactly that client runs at the full factor
+    # (the "one 4x-slow client" scenario); with -1 every client draws a
+    # seeded factor in [1, factor] from its (seed, scope)-keyed stream —
+    # same no-probability-draw discipline as nan_delta_round.
+    fit_delay_factor: float = 0.0
+    fit_delay_cid: int = -1  # -1 = seeded per-client draw
 
 
 @dataclass
@@ -659,6 +668,48 @@ class FLConfig:
 
 
 @dataclass
+class AsyncRoundsConfig:
+    """Asynchronous federated rounds (ISSUE 18, ``federation/async_round.py``).
+
+    OFF by default. Enabled, the synchronous round clock is replaced by a
+    buffered version clock: clients stream deltas when *they* finish, the
+    server folds each arrival into the device plane under
+    staleness-discounted weights, and a new version broadcasts whenever
+    ``buffer_size`` updates have landed. The elastic machinery reframes:
+    deadlines become ``max_staleness`` (a staler delta is rejected with a
+    fresh-version re-broadcast), quorum becomes ``min_arrivals`` (below it
+    the version clock holds still — never an aborted run).
+
+    Bit-parity pin: ``max_staleness`` irrelevant (no staleness arises),
+    ``buffer_size == fl.n_total_clients`` and homogeneous client speed
+    reproduce the synchronous round bit-for-bit — every sync parity oracle
+    carries transitively.
+    """
+
+    enabled: bool = False
+    #: K — deltas buffered before the version clock advances; 0 = the full
+    #: cohort (``fl.n_total_clients``), the sync-parity configuration
+    buffer_size: int = 0
+    #: minimum DISTINCT clients in a full buffer before advancing (the
+    #: quorum analog: a single hyperactive client cannot advance the clock
+    #: alone); the clock stalls — counted + evented — until satisfied
+    min_arrivals: int = 1
+    #: reject deltas whose staleness (server_version − client_base_version)
+    #: exceeds this; the client is re-dispatched from the fresh version
+    max_staleness: int = 4
+    #: staleness-discount policy: ``poly`` → w = (1 + s)^(−power)
+    #: (FedAsync-style polynomial), ``const`` → w = 1 (no discount)
+    staleness_policy: str = "poly"  # poly | const
+    staleness_power: float = 1.0
+    #: version advances to run (0 = fl.n_rounds)
+    n_versions: int = 0
+    #: baseline simulated seconds per client fit in the async round
+    #: simulator (scaled per-client by chaos ``fit_delay_factor``); the
+    #: DES clock is what the bench's wall-clock-to-target-loss measures
+    fit_time_s: float = 1.0
+
+
+@dataclass
 class PhotonConfig:
     """Node/process topology (reference: ``base_schema.py`` photon block)."""
 
@@ -696,6 +747,7 @@ class PhotonConfig:
     compression: CompressionConfig = field(default_factory=CompressionConfig)
     membership: MembershipConfig = field(default_factory=MembershipConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    async_rounds: AsyncRoundsConfig = field(default_factory=AsyncRoundsConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     adapters: AdaptersConfig = field(default_factory=AdaptersConfig)
@@ -1138,6 +1190,65 @@ class Config:
             warnings.warn(
                 "photon.chaos knobs are set but chaos.enabled=False — no "
                 "faults will be injected",
+                stacklevel=2,
+            )
+        ar = self.photon.async_rounds
+        if ar.staleness_policy not in ("poly", "const"):
+            raise ValueError(
+                f"async_rounds.staleness_policy must be 'poly' or 'const', "
+                f"got {ar.staleness_policy!r}"
+            )
+        if ar.buffer_size < 0:
+            raise ValueError(
+                f"async_rounds.buffer_size must be >= 0 (0 = full cohort), "
+                f"got {ar.buffer_size}"
+            )
+        if ar.max_staleness < 0:
+            raise ValueError(
+                f"async_rounds.max_staleness must be >= 0, got {ar.max_staleness}"
+            )
+        if ar.staleness_power < 0:
+            raise ValueError(
+                f"async_rounds.staleness_power must be >= 0, got "
+                f"{ar.staleness_power}"
+            )
+        if ar.n_versions < 0:
+            raise ValueError(
+                f"async_rounds.n_versions must be >= 0 (0 = fl.n_rounds), "
+                f"got {ar.n_versions}"
+            )
+        if ar.fit_time_s <= 0:
+            raise ValueError(
+                f"async_rounds.fit_time_s must be > 0, got {ar.fit_time_s}"
+            )
+        if ar.enabled:
+            if not self.photon.comm_stack.collective:
+                raise ValueError(
+                    "photon.async_rounds needs comm_stack.collective=true: "
+                    "the buffered server folds arrivals through the "
+                    "device-resident aggregation plane"
+                )
+            k = ar.buffer_size or self.fl.n_total_clients
+            if k > self.fl.n_total_clients:
+                raise ValueError(
+                    f"async_rounds.buffer_size={ar.buffer_size} exceeds "
+                    f"fl.n_total_clients={self.fl.n_total_clients} — the "
+                    "buffer could never fill"
+                )
+            if not 1 <= ar.min_arrivals <= k:
+                raise ValueError(
+                    f"async_rounds.min_arrivals must be in [1, K={k}], got "
+                    f"{ar.min_arrivals} (above K the clock could never "
+                    "advance)"
+                )
+        elif (
+            ar.buffer_size or ar.min_arrivals != 1 or ar.max_staleness != 4
+            or ar.staleness_policy != "poly" or ar.staleness_power != 1.0
+            or ar.n_versions or ar.fit_time_s != 1.0
+        ):
+            warnings.warn(
+                "photon.async_rounds knobs are set but async_rounds.enabled="
+                "False — the synchronous round clock will run",
                 stacklevel=2,
             )
         if comp.policy != "off" and self.photon.comm_stack.collective:

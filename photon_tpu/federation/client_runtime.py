@@ -45,6 +45,7 @@ from photon_tpu.train.trainer import Trainer
 from photon_tpu.utils.profiling import (
     CLIENT_ENCODE_SPAN,
     CLIENT_EVALUATE_SPAN,
+    CLIENT_FIT_DELAY_FACTOR,
     CLIENT_FIT_INIT_TIME,
     CLIENT_FIT_SPAN,
     CLIENT_PACKAGE_SPAN,
@@ -318,6 +319,14 @@ class ClientRuntime:
     ) -> FitRes:
         wall = time.monotonic() - t_start
         inj = chaos.active()
+        if inj is not None:
+            # chaos fit slowdown (ISSUE 18): report the deterministic
+            # per-client factor so the async runner's simulated clock (and
+            # the bench's sync baseline) scale this fit's duration by it —
+            # heterogeneous-hardware skew without actually sleeping
+            f = inj.fit_delay_plan(cid)
+            if f != 1.0:
+                metrics = {**metrics, CLIENT_FIT_DELAY_FACTOR: f}
         if inj is not None and inj.nan_delta_plan(ins.server_round, cid):
             # chaos numeric poison (ISSUE 10): one NaN element in the
             # client's outgoing delta — the trainer's own arrays are never

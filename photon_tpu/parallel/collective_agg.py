@@ -469,6 +469,74 @@ def hierarchical_weighted_average(
     return avg
 
 
+def staleness_discount(
+    staleness, policy: str = "poly", power: float = 1.0
+):
+    """Staleness-discount multiplier d(s) for the async buffered server
+    (ISSUE 18): ``poly`` → ``(1 + s)^(−power)`` (the FedAsync polynomial),
+    ``const`` → 1.0. Vectorized over numpy inputs; d(0) == 1.0 EXACTLY
+    under both policies — the zero-staleness bit-parity regime."""
+    s = np.asarray(staleness, np.float64)
+    if np.any(s < 0):
+        raise ValueError("staleness must be >= 0")
+    if policy == "const":
+        return np.ones_like(s)
+    if policy == "poly":
+        return (1.0 + s) ** (-float(power))
+    raise ValueError(f"staleness_policy must be 'poly' or 'const', got {policy!r}")
+
+
+def discounted_fold_weights(
+    n_samples: Sequence[int],
+    staleness: Sequence[int],
+    policy: str = "poly",
+    power: float = 1.0,
+) -> np.ndarray:
+    """Per-client fold weights ``n_i · d(s_i)`` for the discounted entry.
+
+    When every discount is exactly 1 (all-fresh buffer, or the const
+    policy) the weights come back **int32** — the same dtype the
+    synchronous round feeds the fused program, so the async fold reuses
+    the already-compiled sync executable and its result is bit-for-bit
+    the sync round's. Any real discount switches to float32 (one extra
+    compile, absorbed at warmup like every other program variant)."""
+    ns = np.asarray(n_samples)
+    d = staleness_discount(staleness, policy, power)
+    if np.all(d == 1.0):
+        return ns.astype(np.int32)
+    return (ns.astype(np.float64) * d).astype(np.float32)
+
+
+def discounted_weighted_average(
+    stacked_params: Any,
+    n_samples: Sequence[int],
+    staleness: Sequence[int],
+    mesh: Mesh,
+    policy: str = "poly",
+    power: float = 1.0,
+    quantization: str = "off",
+    block: int = DEFAULT_BLOCK,
+    return_total: bool = False,
+) -> Any:
+    """Staleness-discounted weighted average (the ISSUE 18 fold entry):
+    identical program to :func:`hierarchical_weighted_average` with weights
+    pre-scaled by d(staleness) on host — the device body already casts its
+    weight row to fp32, so discounting costs nothing on device and
+    degenerates bit-exactly to the plain average at zero staleness (see
+    :func:`discounted_fold_weights`). ``return_total`` yields Σ n·d — the
+    effective sample mass behind this version, which is what the
+    discounted mean normalizes by."""
+    w = discounted_fold_weights(n_samples, staleness, policy, power)
+    return hierarchical_weighted_average(
+        stacked_params,
+        jax.device_put(w, NamedSharding(mesh, P(CLIENT_AXIS))),
+        mesh,
+        quantization=quantization,
+        block=block,
+        return_total=return_total,
+    )
+
+
 def collective_weighted_average(
     stacked_params: Any,
     n_samples: jax.Array,

@@ -115,6 +115,38 @@ LAYOUT_SEARCH_TIME = "server/layout_search_time"
 #: (compare against the measured step time to audit the cost model)
 LAYOUT_EST_STEP_S = "server/layout_est_step_s"
 
+# -- asynchronous federated rounds (ISSUE 18, federation/async_round.py) --
+# Version-clock KPIs recorded by AsyncFedRunner into History at each
+# version advance (the async analog of the per-round KPI block above):
+#: the server version after this advance (the monotone version clock)
+ASYNC_VERSION = "server/async_version"
+#: client deltas folded into this advance (== the K buffer unless a
+#: same-instant burst advanced multiple versions at once)
+ASYNC_ARRIVALS = "server/async_arrivals"
+#: mean / max staleness (server_version − client_base_version) across the
+#: deltas folded this advance; 0 everywhere == the synchronous round
+ASYNC_STALENESS_MEAN = "server/async_staleness_mean"
+ASYNC_STALENESS_MAX = "server/async_staleness_max"
+#: mean staleness-discount weight multiplier applied this advance (1.0 at
+#: zero staleness — the bit-parity regime)
+ASYNC_DISCOUNT_MEAN = "server/async_discount_mean"
+#: cumulative deltas rejected for staleness > max_staleness (each gets a
+#: fresh-version re-broadcast, never an aborted run)
+ASYNC_REJECTED = "server/async_rejected_total"
+#: cumulative in-flight deltas dropped on a LivenessTracker dead edge
+ASYNC_DROPPED = "server/async_dropped_total"
+#: cumulative buffer-full moments where < min_arrivals distinct clients
+#: had landed — the version clock held still (stall, not abort)
+ASYNC_STALLS = "server/async_stalls_total"
+#: buffered deltas awaiting the next advance, sampled after each arrival
+ASYNC_BUFFER_FILL = "server/async_buffer_fill"
+#: simulated seconds elapsed when this version committed — the modeled
+#: wall clock ``bench.py --async`` measures time-to-target-loss on
+ASYNC_SIM_TIME = "server/async_sim_time"
+#: the chaos fit_delay_plan slowdown factor this fit ran under (1.0 =
+#: no injected skew; the async runner scales simulated durations by it)
+CLIENT_FIT_DELAY_FACTOR = "client/fit_delay_factor"
+
 # -- wire / compression plane (WireStats.metrics_since) -------------------
 WIRE_UPLINK_RAW_BYTES = "server/wire_uplink_raw_bytes"
 WIRE_UPLINK_BYTES = "server/wire_uplink_bytes"
@@ -352,6 +384,18 @@ EVENT_FLEET_REPLICA_DEAD = "fleet/replica_dead"
 EVENT_FLEET_COHORT_REPIN = "fleet/cohort_repin"
 #: one replica finished its leg of a rolling hot-swap pass
 EVENT_FLEET_ROLLING_SWAP = "fleet/rolling_swap"
+#: async server advanced its version clock (attrs: version, arrivals,
+#: staleness_max — the ISSUE 18 analog of a completed round)
+EVENT_ASYNC_VERSION = "async/version_advance"
+#: a delta arrived staler than max_staleness and was rejected; the client
+#: was re-dispatched from a fresh version (attrs: cid, staleness)
+EVENT_ASYNC_REJECT = "async/stale_reject"
+#: a LivenessTracker dead edge dropped a client's in-flight delta before
+#: it could fold (attrs: cid)
+EVENT_ASYNC_DROP = "async/delta_dropped"
+#: the buffer filled but < min_arrivals distinct clients had landed — the
+#: version clock held (stall-not-abort; attrs: buffered, distinct)
+EVENT_ASYNC_STALL = "async/min_arrivals_stall"
 
 # -- structured alert kinds (telemetry/health.py, ISSUE 10) ---------------
 # Health watchers emit these as events (same registry discipline) AND
