@@ -1,7 +1,7 @@
 # PALLAS_AXON_POOL_IPS= disables the TPU-tunnel registration that every
 # python interpreter otherwise performs at startup (sitecustomize) — tests
 # run CPU-only and must not contend for the single tunneled chip.
-.PHONY: test test-all verify bench bench-host bench-telemetry bench-collective bench-zero1 bench-ragged bench-compare chaos chaos-collective telemetry-smoke serve-smoke spec-smoke fleet-smoke adapters-smoke async-smoke lint lint-tests native clean
+.PHONY: test test-all verify bench bench-host bench-telemetry bench-collective bench-zero1 bench-ragged bench-compare chaos chaos-collective telemetry-smoke serve-smoke spec-smoke fleet-smoke adapters-smoke async-smoke autopilot-smoke lint lint-tests native clean
 # native build is best-effort: the package degrades to numpy fallbacks when
 # the .so is absent, so tests must run even without a C++ toolchain
 test:
@@ -186,6 +186,20 @@ async-smoke: lint
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_async_round.py -q -m "slow or not slow"
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --async
+
+# SLO autopilot (ISSUE 19): the feedback-controller suite — windowed
+# reducer exact-value pins, runtime-knob loud rejects, breach/cooldown/
+# saturation/relax state machine on an injected clock, the HBM
+# alert-latch reclaim, per-replica restart cooldown, /statusz decision
+# surfacing, and the seeded chaos-storm e2e through the real scheduler —
+# then the bench gate: through one seeded storm the controlled arm must
+# converge (zero queue rejects AND TPOT p50 inside the declared SLO via
+# real budget actuations) where the uncontrolled arm misses. The fast
+# half rides tier-1 too; lint preflight first like the other smokes.
+autopilot-smoke: lint
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_autopilot.py -q -m "slow or not slow"
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --autopilot
 
 # the chaos-marked fault-injection + elasticity suite (incl. the slow
 # SIGKILL/rejoin e2es): deterministic — every test pins

@@ -2304,6 +2304,150 @@ def fleet_serving_report(n_replicas: int = 4, n_tenants: int = 4,
         return None
 
 
+def autopilot_serving_report(n_requests: int = 24, n_slots: int = 4,
+                             seed: int = 0) -> dict | None:
+    """SLO autopilot convergence under a seeded chaos storm (ISSUE 19):
+    controller ON vs OFF through the SAME storm, same seed.
+
+    The storm: fat prompts (64 tokens against a 128-token prefill budget
+    — two whole prompts fit one chunk) plus the chaos injector's
+    deterministic per-token serve stall, so every fat prefill chunk
+    freezes decode cadence for ~chunk*stall seconds. Uncontrolled, the
+    per-request TPOT (mean inter-token time) blows through the declared
+    SLO. With the autopilot on, queue saturation breaches the
+    ``queue_budget`` rule and the controller walks the prefill budget
+    down (128 → 4, halving per cooldown), restoring decode cadence
+    mid-storm — the convergence the ISSUE 19 acceptance gate demands.
+
+    Exit gates (bench.py --autopilot / make autopilot-smoke): the ON run
+    converges (zero queue rejects AND TPOT p50 <= slo_tpot_p50_s) where
+    the OFF run misses at least one of the two, and the ON run actually
+    actuated (>= 1 ``autopilot/actuation`` decision on the budget knob).
+    """
+    try:
+        import numpy as np
+
+        from photon_tpu import chaos, telemetry
+        from photon_tpu.config.schema import Config
+        from photon_tpu.models.mpt import init_params
+        from photon_tpu.serve.engine import PagedEngine
+        from photon_tpu.serve.scheduler import ContinuousBatcher
+        from photon_tpu.utils.profiling import (
+            AUTOPILOT_KNOB_PREFILL_BUDGET,
+            EVENT_AUTOPILOT_ACTUATION,
+            SERVE_TPOT_S,
+        )
+
+        slo_tpot_p50_s = 0.06
+        budget = 128
+
+        cfg = Config()
+        cfg.model.d_model = 32
+        cfg.model.n_layers = 2
+        cfg.model.n_heads = 4
+        cfg.model.max_seq_len = 128
+        cfg.model.vocab_size = 96
+        cfg.model.attn_impl = "xla"
+        cfg.model.compute_dtype = "float32"
+        cfg.photon.serve.n_slots = n_slots
+        cfg.photon.serve.block_size = 8
+        cfg.photon.serve.max_new_tokens = 8
+        cfg.photon.telemetry.enabled = True
+        apc = cfg.photon.telemetry.autopilot
+        apc.enabled = True  # flipped per arm below
+        apc.period_s = 0.05
+        apc.cooldown_s = 0.1
+        apc.queue_high_frac = 0.35
+        apc.queue_clear_frac = 0.1
+        apc.prefill_budget_min = 4
+        apc.prefill_shrink = 0.5
+        cfg.photon.chaos.enabled = True
+        cfg.photon.chaos.seed = 1234
+        cfg.photon.chaos.serve_stall_per_token_s = 0.002
+        cfg.validate()
+
+        engine = PagedEngine(cfg, init_params(cfg.model, seed=4))
+        rng = np.random.default_rng(seed)
+        prompts = [list(map(int, rng.integers(1, 96, 64)))
+                   for _ in range(n_requests)]
+
+        # warmup OUTSIDE both arms: compile every (chunk, live-width)
+        # bucket with no chaos installed, so neither arm's TPOT gaps
+        # carry one-time XLA compile time
+        wb = ContinuousBatcher(engine, max_queue=n_requests + 8,
+                               prefill_token_budget=budget).start()
+        try:
+            for r in [wb.submit(p, 8) for p in prompts[:4]]:
+                r.result(timeout=600)
+            wb.set_prefill_token_budget(4)
+            for r in [wb.submit(p, 8) for p in prompts[:4]]:
+                r.result(timeout=600)
+        finally:
+            wb.close()
+
+        def run_arm(autopilot_on: bool) -> dict:
+            apc.enabled = autopilot_on
+            telemetry.install(cfg.photon.telemetry, scope="bench-ap")
+            chaos.install(cfg.photon.chaos, scope="bench-ap")
+            batcher = ContinuousBatcher(
+                engine, max_queue=n_requests + 8,
+                prefill_token_budget=budget,
+            ).start()
+            try:
+                t0 = time.perf_counter()
+                reqs = [batcher.submit(p, 8) for p in prompts]
+                for r in reqs:
+                    r.result(timeout=600)
+                wall = time.perf_counter() - t0
+                hub = telemetry.metrics_active()
+                tpot = hub.histogram(SERVE_TPOT_S).percentile(0.5)
+                ap = telemetry.autopilot_active()
+                decisions = ap.statusz()["decisions"] if ap else []
+                arm = {
+                    "wall_s": round(wall, 3),
+                    "rejected": batcher.rejected,
+                    "tpot_p50_s": round(tpot, 5) if tpot else None,
+                    "budget_final": batcher.prefill_token_budget,
+                    "stall_ticks": chaos.active().counts["serve_stall"],
+                    "actuations": sum(
+                        1 for d in decisions
+                        if d["event"] == EVENT_AUTOPILOT_ACTUATION
+                        and d["knob"] == AUTOPILOT_KNOB_PREFILL_BUDGET
+                    ),
+                    "decisions": decisions[-8:],
+                }
+                return arm
+            finally:
+                batcher.close()
+                chaos.uninstall()
+                telemetry.uninstall()
+
+        off = run_arm(False)
+        on = run_arm(True)
+
+        def misses(arm: dict) -> int:
+            n = 1 if arm["rejected"] else 0
+            if arm["tpot_p50_s"] is None or arm["tpot_p50_s"] > slo_tpot_p50_s:
+                n += 1
+            return n
+
+        return {
+            "slo_tpot_p50_s": slo_tpot_p50_s,
+            "budget_declared": budget,
+            "off": off,
+            "on": on,
+            "converged": misses(on) == 0 and on["actuations"] >= 1,
+            "uncontrolled_misses": misses(off),
+            "tpot_p50_improvement": (
+                round(off["tpot_p50_s"] / on["tpot_p50_s"], 3)
+                if off["tpot_p50_s"] and on["tpot_p50_s"] else None
+            ),
+        }
+    except Exception as e:  # noqa: BLE001 — never cost the round its numbers
+        log(f"autopilot serving report failed: {type(e).__name__}: {e}")
+        return None
+
+
 # ---------------------------------------------------------------------------
 # Device-collective aggregation plane (ISSUE 7; lands in the BENCH_*.json)
 # ---------------------------------------------------------------------------
@@ -3096,6 +3240,12 @@ def _fleet_affinity_tps(parsed: dict) -> float | None:
     return _dig(parsed, ("serving_fleet", "affinity", "tokens_per_s"))
 
 
+def _autopilot_tpot_improvement(parsed: dict) -> float | None:
+    """How much TPOT p50 the controller claws back under the chaos storm
+    (off/on ratio; the regime the SLO autopilot exists for, ISSUE 19)."""
+    return _dig(parsed, ("serving_autopilot", "tpot_p50_improvement"))
+
+
 #: gated headline numbers, (extractor, label, platform_sensitive). Higher
 #: is better for all; a drop past the threshold exits nonzero.
 _COMPARE_GATES = (
@@ -3105,6 +3255,9 @@ _COMPARE_GATES = (
     (_spec_templated_tps, "serving_speculative_templated_tokens_per_s",
      False),
     (_fleet_affinity_tps, "serving_fleet_affinity_tokens_per_s", False),
+    # autopilot TPOT-p50 protection under the seeded chaos storm (ISSUE 19)
+    (_autopilot_tpot_improvement, "serving_autopilot_tpot_p50_improvement",
+     False),
     # fused-grouped-reduction win over K sequential reductions (ISSUE 13)
     (lambda p: _dig(p, ("adapters", "fused_speedup")),
      "adapters_fused_speedup", False),
@@ -3598,6 +3751,12 @@ def run(platform: str) -> None:
         if ft is not None:
             out["serving_fleet"] = ft
             emit(out)
+        # SLO autopilot (ISSUE 19): controller on/off through the same
+        # seeded chaos storm — convergence + TPOT-p50 protection factor
+        apr = autopilot_serving_report()
+        if apr is not None:
+            out["serving_autopilot"] = apr
+            emit(out)
 
     # device-collective aggregation plane (own child interpreter — the
     # emulated 8-device CPU mesh must exist before jax initializes): flat
@@ -3782,6 +3941,15 @@ def main() -> int:
                          "affinity beats random on BOTH aggregate tokens/s "
                          "and mean TTFT and the kill run drops zero "
                          "requests on survivors")
+    ap.add_argument("--autopilot", action="store_true",
+                    help="run only the SLO-autopilot storm report "
+                         "(controller on vs off through the same seeded "
+                         "chaos serve storm, tiny CPU model) and print "
+                         "{'serving_autopilot': ...}; exits nonzero unless "
+                         "the controlled run converges (zero queue rejects "
+                         "AND TPOT p50 within the declared SLO, with >= 1 "
+                         "budget actuation) where the uncontrolled run "
+                         "misses at least one of the two")
     ap.add_argument("--adapters", action="store_true",
                     help="per-cohort LoRA plane gate (ISSUE 13): modeled "
                          "adapter wire bytes >= 50x below a full-model "
@@ -3899,6 +4067,19 @@ def main() -> int:
         return 0 if (tps_gain and tps_gain > 1.0
                      and ttft_gain and ttft_gain > 1.0
                      and kill.get("dropped_on_survivors") == 0) else 1
+    if args.autopilot:
+        # the ISSUE 19 gate alone (make autopilot-smoke): through one
+        # seeded chaos storm, the controller must CONVERGE — no queue
+        # rejects and TPOT p50 back inside the declared SLO, via real
+        # autopilot/actuation decisions on the budget knob — where the
+        # uncontrolled arm provably misses the same SLOs
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        apr = autopilot_serving_report()
+        emit({"serving_autopilot": apr})
+        if apr is None:
+            return 1
+        return 0 if (apr["converged"]
+                     and apr["uncontrolled_misses"] >= 1) else 1
     if args.adapters:
         # CPU-jax only, fresh backend (the emulated client mesh must be
         # configured before jax initializes — the in-run bench reaches

@@ -180,6 +180,7 @@ def check_metric_discipline(ctx: FileContext) -> Iterator[Finding]:
 _ACTIVE_FNS = frozenset({
     "active", "events_active", "lock_order_active", "retrace_active",
     "metrics_active", "health_active", "profiler_active",
+    "autopilot_active",
 })
 
 

@@ -91,6 +91,7 @@ class FaultInjector:
             "store_read_slow": 0, "store_read_partial": 0,
             "store_read_bitflip": 0, "crash": 0, "nan_delta": 0,
             "replica_kill": 0, "fit_delay": 0,
+            "serve_stall": 0, "hbm_ramp": 0,
         }
         # total CORRUPTING store faults (partial/bitflip, reads + writes)
         # fired, bounded by cfg.store_fault_max (0 = unlimited) — "corrupt
@@ -218,6 +219,35 @@ class FaultInjector:
             f = 1.0 + (factor - 1.0) * rng.random()
         self._fired("fit_delay", cid=cid, factor=round(f, 4))
         return f
+
+    # -- serve fault storm (ISSUE 19) ------------------------------------
+    def serve_stall_plan(self, tokens: int) -> float:
+        """Seconds to stall this serve tick: ``serve_stall_per_token_s``
+        times the tokens the tick's engine step carried (chunk + emitted).
+        Deterministic — no probability draw: the SLO-autopilot storm needs
+        the slowdown proportional to the work the controller's budget knob
+        actually bounds, every tick, both bench arms identical."""
+        c = self.cfg
+        per = float(getattr(c, "serve_stall_per_token_s", 0.0) or 0.0)
+        if per <= 0.0 or tokens <= 0:
+            return 0.0
+        delay = per * tokens
+        self._fired("serve_stall", tokens=int(tokens),
+                    delay_s=round(delay, 6))
+        return delay
+
+    def hbm_ramp_plan(self) -> float:
+        """The multiplicative HBM inflation for this serve device sample:
+        the n-th call returns ``serve_hbm_ramp_frac * n`` — strictly
+        monotone growth that latches the health plane's HBM watcher within
+        one sample window, without real memory pressure. 0.0 = off."""
+        c = self.cfg
+        frac = float(getattr(c, "serve_hbm_ramp_frac", 0.0) or 0.0)
+        if frac <= 0.0:
+            return 0.0
+        n = self.counts["hbm_ramp"] + 1
+        self._fired("hbm_ramp", sample=n)
+        return frac * n
 
     # -- fleet replica kill (ISSUE 16) -----------------------------------
     def replica_kill_plan(self, requests_routed: int,
@@ -352,4 +382,14 @@ def validate_chaos_config(cfg) -> None:
         raise ValueError(
             f"chaos.fit_delay_cid must be >= -1 (-1 = seeded per-client), "
             f"got {cfg.fit_delay_cid}"
+        )
+    if getattr(cfg, "serve_stall_per_token_s", 0.0) < 0.0:
+        raise ValueError(
+            f"chaos.serve_stall_per_token_s must be >= 0 (0 = off), got "
+            f"{cfg.serve_stall_per_token_s}"
+        )
+    if getattr(cfg, "serve_hbm_ramp_frac", 0.0) < 0.0:
+        raise ValueError(
+            f"chaos.serve_hbm_ramp_frac must be >= 0 (0 = off), got "
+            f"{cfg.serve_hbm_ramp_frac}"
         )

@@ -366,6 +366,70 @@ class ChaosConfig:
     # same no-probability-draw discipline as nan_delta_round.
     fit_delay_factor: float = 0.0
     fit_delay_cid: int = -1  # -1 = seeded per-client draw
+    # serve fault storm (ISSUE 19): deterministic per-token tick stall —
+    # amplifies the compute-proportional cost of a serve tick so shrinking
+    # the prefill chunk budget measurably protects decode cadence (TPOT)
+    # on CPU test hardware. Seconds of stall per token stepped in a tick;
+    # 0 = off. Same no-probability-draw discipline as fit_delay_factor.
+    serve_stall_per_token_s: float = 0.0
+    # deterministic HBM-pressure ramp (ISSUE 19): the n-th serve device
+    # sample is inflated by ``1 + frac * n`` — strictly monotone growth
+    # that latches the health plane's HBM watcher without real memory
+    # pressure. 0 = off.
+    serve_hbm_ramp_frac: float = 0.0
+
+
+@dataclass
+class AutopilotConfig:
+    """SLO autopilot (ISSUE 19, ``photon_tpu/telemetry/autopilot.py``).
+
+    A feedback controller that closes the observe→actuate loop: declared
+    SLO targets are evaluated periodically against windowed reductions of
+    the typed-metric hub, and breaches drive runtime-mutable knobs the
+    owning subsystems registered at install time. OFF by default; the
+    disabled cost is one ``None`` check per hook site. Rules whose target
+    is 0 are individually off. Every actuation is reversible: after
+    ``relax_after`` consecutive clean evaluations a rule probes its knob
+    back toward the value the subsystem declared at registration.
+    """
+
+    enabled: bool = False
+    period_s: float = 0.25  # min seconds between evaluations, per plane
+    cooldown_s: float = 2.0  # per-rule min seconds between actuations
+    relax_after: int = 3  # clean evaluations before a relax probe
+    window_s: float = 30.0  # trailing window for metric reductions
+    decisions: int = 64  # decision ring surfaced on /statusz
+    # hysteresis for rules without an explicit clear bound: an evaluation
+    # is clean only when observed <= clear_frac * target
+    clear_frac: float = 0.8
+    # serve: queue saturation -> shrink prefill_token_budget so admissions
+    # drain through cheaper ticks BEFORE the 429 path fires
+    queue_high_frac: float = 0.75  # breach when ewma(depth)/max_queue >= this
+    queue_clear_frac: float = 0.25  # hysteresis: clean only at/below this
+    prefill_budget_min: int = 16  # knob floor (declared value is the ceiling)
+    prefill_shrink: float = 0.5  # multiplicative tighten step
+    # serve: TPOT p50 SLO -> lower the SpecController K ceiling (0 = off)
+    tpot_p50_slo_s: float = 0.0
+    spec_k_min: int = 1
+    # serve/collective: HBM-growth alert -> prefix-cache eviction + adapter
+    # LRU shrink (the reclaim action)
+    reclaim_free_blocks: int = 8  # PrefixCache.ensure_free target
+    # collective: straggler-frac p90 over the window -> tighten the stage
+    # timeout so stragglers are cut loose sooner (0 = off)
+    straggler_p90: float = 0.0
+    stage_timeout_min_s: float = 5.0
+    stage_timeout_shrink: float = 0.75
+    # collective: wire-bytes slope (bytes/s) -> escalate collective
+    # quantization off->q8 (0 = off)
+    wire_slope_bytes_per_s: float = 0.0
+    # async: stale-reject rate (rejects per version advance) -> widen
+    # max_staleness within [declared, max_staleness_hi] (0 = off)
+    async_reject_per_version: float = 0.0
+    max_staleness_hi: int = 16
+    # fleet: a replica whose compile counter moved on this many consecutive
+    # report polls (steady-state retraces) or whose HBM watcher latched is
+    # drained and restarted through the control plane (0 = off)
+    replica_compile_streak: int = 0
 
 
 @dataclass
@@ -394,6 +458,9 @@ class TelemetryConfig:
     #: per-instrument ring-buffer samples the typed-metric hub retains (the
     #: time-series view health watchers compute percentiles over)
     metrics_retention: int = 512
+    #: SLO autopilot (ISSUE 19): the feedback controller that closes the
+    #: observe→actuate loop over this plane's hub + health monitor
+    autopilot: AutopilotConfig = field(default_factory=AutopilotConfig)
 
 
 @dataclass
@@ -1174,6 +1241,112 @@ class Config:
                 "— no profile will be captured",
                 stacklevel=2,
             )
+        apc = tel.autopilot
+        if apc.enabled and not tel.enabled:
+            raise ValueError(
+                "telemetry.autopilot.enabled needs telemetry.enabled=true: "
+                "the controller reads the process-global metrics hub and "
+                "health monitor"
+            )
+        if apc.period_s <= 0:
+            raise ValueError(
+                f"telemetry.autopilot.period_s must be > 0, got {apc.period_s}"
+            )
+        if apc.cooldown_s < 0:
+            raise ValueError(
+                f"telemetry.autopilot.cooldown_s must be >= 0, got "
+                f"{apc.cooldown_s}"
+            )
+        if apc.relax_after < 1:
+            raise ValueError(
+                f"telemetry.autopilot.relax_after must be >= 1, got "
+                f"{apc.relax_after}"
+            )
+        if apc.window_s <= 0:
+            raise ValueError(
+                f"telemetry.autopilot.window_s must be > 0, got {apc.window_s}"
+            )
+        if apc.decisions < 1:
+            raise ValueError(
+                f"telemetry.autopilot.decisions must be >= 1, got "
+                f"{apc.decisions}"
+            )
+        if not 0.0 < apc.clear_frac <= 1.0:
+            raise ValueError(
+                f"telemetry.autopilot.clear_frac must be in (0, 1], got "
+                f"{apc.clear_frac}"
+            )
+        if not 0.0 < apc.queue_high_frac <= 1.0:
+            raise ValueError(
+                f"telemetry.autopilot.queue_high_frac must be in (0, 1], got "
+                f"{apc.queue_high_frac}"
+            )
+        if not 0.0 <= apc.queue_clear_frac < apc.queue_high_frac:
+            raise ValueError(
+                f"telemetry.autopilot.queue_clear_frac must be in "
+                f"[0, queue_high_frac={apc.queue_high_frac}), got "
+                f"{apc.queue_clear_frac}"
+            )
+        if apc.prefill_budget_min < 1:
+            raise ValueError(
+                f"telemetry.autopilot.prefill_budget_min must be >= 1, got "
+                f"{apc.prefill_budget_min}"
+            )
+        if not 0.0 < apc.prefill_shrink < 1.0:
+            raise ValueError(
+                f"telemetry.autopilot.prefill_shrink must be in (0, 1), got "
+                f"{apc.prefill_shrink}"
+            )
+        if apc.tpot_p50_slo_s < 0:
+            raise ValueError(
+                f"telemetry.autopilot.tpot_p50_slo_s must be >= 0 (0 = off), "
+                f"got {apc.tpot_p50_slo_s}"
+            )
+        if apc.spec_k_min < 1:
+            raise ValueError(
+                f"telemetry.autopilot.spec_k_min must be >= 1, got "
+                f"{apc.spec_k_min}"
+            )
+        if apc.reclaim_free_blocks < 0:
+            raise ValueError(
+                f"telemetry.autopilot.reclaim_free_blocks must be >= 0, got "
+                f"{apc.reclaim_free_blocks}"
+            )
+        if not 0.0 <= apc.straggler_p90 <= 1.0:
+            raise ValueError(
+                f"telemetry.autopilot.straggler_p90 must be in [0, 1] "
+                f"(0 = off), got {apc.straggler_p90}"
+            )
+        if apc.stage_timeout_min_s <= 0:
+            raise ValueError(
+                f"telemetry.autopilot.stage_timeout_min_s must be > 0, got "
+                f"{apc.stage_timeout_min_s}"
+            )
+        if not 0.0 < apc.stage_timeout_shrink < 1.0:
+            raise ValueError(
+                f"telemetry.autopilot.stage_timeout_shrink must be in "
+                f"(0, 1), got {apc.stage_timeout_shrink}"
+            )
+        if apc.wire_slope_bytes_per_s < 0:
+            raise ValueError(
+                f"telemetry.autopilot.wire_slope_bytes_per_s must be >= 0 "
+                f"(0 = off), got {apc.wire_slope_bytes_per_s}"
+            )
+        if apc.async_reject_per_version < 0:
+            raise ValueError(
+                f"telemetry.autopilot.async_reject_per_version must be >= 0 "
+                f"(0 = off), got {apc.async_reject_per_version}"
+            )
+        if apc.max_staleness_hi < 0:
+            raise ValueError(
+                f"telemetry.autopilot.max_staleness_hi must be >= 0, got "
+                f"{apc.max_staleness_hi}"
+            )
+        if apc.replica_compile_streak < 0:
+            raise ValueError(
+                f"telemetry.autopilot.replica_compile_streak must be >= 0 "
+                f"(0 = off), got {apc.replica_compile_streak}"
+            )
         from photon_tpu.chaos.injector import validate_chaos_config
 
         validate_chaos_config(self.photon.chaos)
@@ -1184,6 +1357,7 @@ class Config:
                 for p in (
                     "tcp_drop_p", "tcp_delay_p", "tcp_duplicate_p", "tcp_corrupt_p",
                     "store_slow_p", "store_partial_p", "store_bitflip_p",
+                    "serve_stall_per_token_s", "serve_hbm_ramp_frac",
                 )
             )
         ):

@@ -86,6 +86,7 @@ from photon_tpu.utils.profiling import (
     ASYNC_STALENESS_MEAN,
     ASYNC_STALLS,
     ASYNC_VERSION,
+    AUTOPILOT_KNOB_MAX_STALENESS,
     CLIENT_FIT_DELAY_FACTOR,
     COLLECTIVE_AGG_TIME,
     COLLECTIVE_WIRE_BYTES,
@@ -167,6 +168,29 @@ class AsyncFedRunner(CollectiveFedRunner):
         self.stalls_total = 0
         self.folds_failed_total = 0
         self._zero_row_cache: list[np.ndarray] | None = None
+        # SLO autopilot knob (ISSUE 19): the reject-rate rule widens the
+        # staleness bound when too many fits die at admission
+        ap = telemetry.autopilot_active()
+        if ap is not None:
+            ap.register_knob(
+                AUTOPILOT_KNOB_MAX_STALENESS,
+                lambda: self.max_staleness,
+                self.set_max_staleness,
+                integer=True,
+            )
+
+    def set_max_staleness(self, max_staleness: int) -> None:
+        """Runtime-mutable staleness bound (ISSUE 19): the autopilot widens
+        it when the per-version reject rate breaches, and relaxes it back
+        toward the declared bound as rejects clear. Loud reject on negative
+        values — 0 is legal (only same-version deltas fold)."""
+        s = int(max_staleness)
+        if s < 0:
+            raise ValueError(
+                f"set_max_staleness needs max_staleness >= 0, got "
+                f"{max_staleness!r}"
+            )
+        self.max_staleness = s
 
     # -- dispatch ---------------------------------------------------------
     def _zero_row(self) -> list[np.ndarray]:
@@ -589,6 +613,15 @@ class AsyncFedRunner(CollectiveFedRunner):
             if self.version < target:
                 for cid in redispatch:
                     self._dispatch(cid)
+            ap = telemetry.autopilot_active()
+            if ap is not None:
+                # the async plane has no hub mirror of its ladder counters;
+                # the reject-rate rule reduces over these context deltas
+                ap.tick(
+                    "async",
+                    rejected_total=self.rejected_total,
+                    version=self.version,
+                )
             steady_point("async/event")
         return self.history
 

@@ -87,7 +87,10 @@ from photon_tpu import telemetry
 from photon_tpu.analysis.runtime import absorb_compiles, steady_point
 from photon_tpu.chaos import crash_point
 from photon_tpu.codec import params_to_ndarrays
-from photon_tpu.compression.quantize import DEFAULT_BLOCK
+from photon_tpu.compression.quantize import (
+    COLLECTIVE_QUANTIZATIONS,
+    DEFAULT_BLOCK,
+)
 from photon_tpu.config.schema import Config
 from photon_tpu.federation.client_runtime import ClientRuntime
 from photon_tpu.federation.membership import LIVE, LivenessTracker
@@ -96,11 +99,14 @@ from photon_tpu.utils.profiling import (
     ADAPTER_COHORTS,
     ADAPTER_COHORTS_DEGRADED,
     ADAPTER_WIRE_BYTES,
+    AUTOPILOT_KNOB_QUANT_LEVEL,
+    AUTOPILOT_KNOB_STAGE_TIMEOUT_S,
     COLLECTIVE_AGG_TIME,
     COLLECTIVE_DEGRADED_ROUNDS,
     COLLECTIVE_EXCHANGE_TIME,
     COLLECTIVE_RECONFIG_TIME,
     COLLECTIVE_STACK_TIME,
+    COLLECTIVE_STRAGGLER_FRAC,
     COLLECTIVE_STRAGGLERS,
     COLLECTIVE_UPDATE_TIME,
     COLLECTIVE_WIRE_BYTES,
@@ -346,7 +352,63 @@ class CollectiveFedRunner:
         # sample position (ClientRuntime fit), and rides the checkpoint so
         # resume replays the same data order
         self.client_states: dict[int, dict] = {}
+        # SLO autopilot knobs (ISSUE 19): the collective plane owns the
+        # stage deadline and the DCN quantization level — registered here
+        # so the controller actuates through the bounds-checked setters
+        ap = telemetry.autopilot_active()
+        if ap is not None:
+            ap.register_knob(
+                AUTOPILOT_KNOB_STAGE_TIMEOUT_S,
+                lambda: self.stage_timeout_s,
+                self.set_stage_timeout_s,
+            )
+            ap.register_knob(
+                AUTOPILOT_KNOB_QUANT_LEVEL,
+                lambda: self.quantization,
+                self.set_quantization,
+                levels=COLLECTIVE_QUANTIZATIONS,
+            )
         self._warmup_collective()
+
+    # -- runtime-mutable knobs (ISSUE 19) ------------------------------
+    def set_stage_timeout_s(self, timeout_s: float) -> None:
+        """Runtime-mutable stage deadline: the autopilot tightens this when
+        the straggler fraction's p90 breaches. Loud reject, never a silent
+        clamp — 0 would restore wedge-forever semantics mid-run, which no
+        controller should ever do to a live gang."""
+        t = float(timeout_s)
+        if not np.isfinite(t) or t <= 0.0:
+            raise ValueError(
+                f"set_stage_timeout_s needs a finite timeout > 0, got "
+                f"{timeout_s!r}"
+            )
+        self.stage_timeout_s = t
+
+    def set_quantization(self, quantization: str) -> None:
+        """Runtime quantization escalation (ISSUE 19): when the wire-bytes
+        counter trends up, the autopilot steps ``off`` → ``q8`` on the DCN
+        leg. The fused device-optimizer program bakes the codec in, so the
+        switch rebuilds the :class:`DeviceAggregationPlane` from the host
+        strategy replica — the checkpoint authority, synced after every
+        round — under an ``absorb_compiles`` window (a deliberate
+        reconfiguration compile, not a retrace bug)."""
+        if quantization not in COLLECTIVE_QUANTIZATIONS:
+            raise ValueError(
+                f"unknown collective quantization {quantization!r}, "
+                f"expected one of {COLLECTIVE_QUANTIZATIONS}"
+            )
+        if quantization == self.quantization:
+            return
+        self.quantization = quantization
+        if self.device_plane is not None:
+            cs = self.cfg.photon.comm_stack
+            with absorb_compiles("collective/requantize"):
+                self.device_plane = DeviceAggregationPlane(
+                    self.mesh, self.strategy,
+                    quantization=self.quantization, block=self.q8_block,
+                    nonneg_rows=self._nonneg_rows,
+                    sharded=cs.collective_zero1,
+                )
 
     def _warmup_collective(self) -> None:
         """Establish the cross-process collective context BEFORE the first
@@ -668,6 +730,11 @@ class CollectiveFedRunner:
             wire = metrics.get(COLLECTIVE_WIRE_BYTES)
             if wire:
                 hub.counter(COLLECTIVE_WIRE_BYTES).inc(float(wire))
+            # the autopilot's straggler-deadline rule reduces p90 over this
+            # gauge's window (ISSUE 19)
+            hub.gauge(COLLECTIVE_STRAGGLER_FRAC).set(
+                stragglers / max(1, self.cfg.fl.n_total_clients)
+            )
             sample_device_plane(
                 metrics, hub, hbm_key=HBM_BYTES_IN_USE,
                 peak_key=HBM_PEAK_BYTES, compiles_key=COMPILES_TOTAL,
@@ -685,6 +752,9 @@ class CollectiveFedRunner:
             hbm = metrics.get(HBM_BYTES_IN_USE)
             if hbm is not None:
                 health.note_hbm_sample(hbm)
+        ap = telemetry.autopilot_active()
+        if ap is not None:
+            ap.tick("collective")
 
     # -- the straggler/degradation ladder (ISSUE 8) --------------------
     def _aggregate_elastic(

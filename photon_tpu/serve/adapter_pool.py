@@ -105,6 +105,24 @@ class AdapterPool:
             self.allocator.free([page])
         self._pages.clear()
 
+    def shrink(self, keep: int = 0) -> int:
+        """Evict UNPINNED resident pages, LRU-first, until at most ``keep``
+        residents remain (ISSUE 19: the HBM-pressure reclaim actuator).
+        Pages pinned by live slots are skipped — unlike :meth:`flush` this
+        is safe under live traffic; a skipped page becomes evictable the
+        moment its last request releases. Evicted cohorts reload from the
+        host bank on their next admission. Returns pages evicted."""
+        dropped = 0
+        for cohort, page in list(self._pages.items()):
+            if len(self._pages) <= keep:
+                break
+            if self.allocator.refcount(page) != 1:
+                continue
+            self.allocator.free([self._pages.pop(cohort)])
+            self.evictions += 1
+            dropped += 1
+        return dropped
+
     def has_cohort(self, cohort: str) -> bool:
         return cohort in self._bank
 

@@ -188,10 +188,23 @@ class SpecController:
         self.spec_steps = 0
         self._ticks_throttled = 0
 
+    def set_k_max(self, k_max: int) -> None:
+        """Runtime-mutable depth ceiling (ISSUE 19): the SLO autopilot
+        lowers this when TPOT p50 regresses and probes it back up when the
+        breach clears. Out-of-range values are rejected loudly, never
+        clamped silently. ``k_max=0`` turns drafting fully off — including
+        the periodic probe (:meth:`next_k` clamps its probe column to the
+        ceiling), so a TPOT-breached engine stops paying even the probe's
+        verify column."""
+        k = int(k_max)
+        if k < 0:
+            raise ValueError(f"set_k_max needs k_max >= 0 (0 = off), got {k_max}")
+        self.k_max = k
+
     def k_effective(self) -> int:
         """The throttle's CURRENT depth (pure — the KPI gauge reads this
         without advancing the probe clock). 0 = plain decode."""
-        if self.ewma >= self.accept_floor:
+        if self.k_max and self.ewma >= self.accept_floor:
             return max(1, min(self.k_max, round(self.ewma * self.k_max)))
         return 0
 
@@ -206,7 +219,9 @@ class SpecController:
         self._ticks_throttled += 1
         if self.probe_ticks and self._ticks_throttled >= self.probe_ticks:
             self._ticks_throttled = 0
-            return 1  # the probe: one cheap draft column
+            # the probe: one cheap draft column — clamped to the ceiling
+            # so a k_max=0 (autopilot-silenced) controller stays off
+            return min(1, self.k_max)
         return 0
 
     def observe(self, drafted: int, accepted: int) -> None:

@@ -48,7 +48,7 @@ from photon_tpu import telemetry
 from photon_tpu.federation.membership import ReconnectPolicy
 from photon_tpu.federation.messages import Ack, Envelope, Query
 from photon_tpu.federation.tcp import HELLO_KIND, SocketConn
-from photon_tpu.utils.profiling import EVENT_TCP_RECONNECT
+from photon_tpu.utils.profiling import COMPILES_TOTAL, EVENT_TCP_RECONNECT
 
 
 class ReplicaAgent:
@@ -188,6 +188,17 @@ class ReplicaAgent:
                 name=f"photon-fleet-drain-{self.replica_id}", daemon=True,
             ).start()
             return Ack(ok=True, node_id=self.replica_id)
+        if q.action == "restart":
+            # soft restart (ISSUE 19): quiesce in place, not process death.
+            # The frontend 503s while the batcher recycles (bounded drain +
+            # cache/pool flush); serving resumes on the same engine. The ack
+            # must not wait on the drain — same contract as ``drain``.
+            self.frontend.mark_draining()
+            threading.Thread(
+                target=self._recycle,
+                name=f"photon-fleet-restart-{self.replica_id}", daemon=True,
+            ).start()
+            return Ack(ok=True, node_id=self.replica_id)
         if q.action == "hotswap":
             if self.watcher is None:
                 return Ack(ok=False, detail="no hot-swap watcher",
@@ -201,6 +212,12 @@ class ReplicaAgent:
         return Ack(ok=False, detail=f"unknown action {q.action!r}",
                    node_id=self.replica_id)
 
+    def _recycle(self) -> None:
+        try:
+            self.batcher.recycle(self.drain_timeout_s)
+        finally:
+            self.frontend.draining = False
+
     def report(self) -> dict:
         eng = self.batcher.engine
         cohorts: list = []
@@ -213,6 +230,19 @@ class ReplicaAgent:
             "round": eng.loaded_round if eng.loaded_round is not None else -1,
         }
         rep.update(self.batcher.load_report())
+        # replica health + compile telemetry ride the same round-trip
+        # (ISSUE 19): the router's autopilot decides restarts from these
+        health = telemetry.health_active()
+        if health is not None:
+            plane = health.statusz().get("planes", {}).get("serve")
+            if plane is not None:
+                rep["health"] = {
+                    "status": plane.get("status"),
+                    "reason": plane.get("reason"),
+                }
+        hub = telemetry.metrics_active()
+        if hub is not None:
+            rep["compiles"] = float(hub.counter(COMPILES_TOTAL).value)
         return rep
 
 

@@ -155,6 +155,9 @@ class ServeFrontend:
                                else {"status": "ok", "planes": {},
                                      "alerts": [], "telemetry": "off"})
                     payload["draining"] = fe.draining
+                    ap = telemetry.autopilot_active()
+                    if ap is not None:
+                        payload["autopilot"] = ap.statusz()
                     self._json(200, payload)
                 else:
                     self._discard_body()

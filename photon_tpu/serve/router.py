@@ -63,6 +63,7 @@ from photon_tpu.metrics.history import History
 from photon_tpu.serve.prefix import prefix_hashes
 from photon_tpu.telemetry.prom import negotiate_exposition, render_exposition
 from photon_tpu.utils.profiling import (
+    ALERT_HBM_GROWTH,
     EVENT_FLEET_COHORT_REPIN,
     EVENT_FLEET_REPLICA_DEAD,
     EVENT_FLEET_REPLICA_UP,
@@ -254,6 +255,14 @@ class FleetRouter:
         self._lock = threading.Lock()  # replicas + pins + counters
         self._ctl_lock = threading.Lock()  # exclusive driver send/recv use
         self._last_states: dict[str, str] = {}
+        # replica-restart autopilot state (ISSUE 19): last reported compile
+        # total + consecutive-growth streak per replica, and the restarts
+        # approved during a poll cycle — executed AFTER the control lock is
+        # released (the restart query needs it; issuing inside the ingest
+        # would deadlock)
+        self._compiles: dict[str, float] = {}
+        self._compile_streaks: dict[str, int] = {}
+        self._pending_restarts: list[str] = []
         self._tick = 0
         self._stop = threading.Event()
         self._poll_thread: threading.Thread | None = None
@@ -320,6 +329,20 @@ class FleetRouter:
                 self.tracker.observe_miss(nid)
         self._apply_transitions()
         self._record_kpis()
+        self._drain_restarts()
+
+    def _drain_restarts(self) -> None:
+        """Execute the poll cycle's autopilot-approved replica restarts —
+        outside the cycle's control-lock hold (the restart query
+        re-acquires it per exchange)."""
+        pending, self._pending_restarts = self._pending_restarts, []
+        for nid in pending:
+            ack = self._query(nid, "restart", self.fc.report_timeout_s)
+            if ack is None or not ack.ok:
+                warnings.warn(
+                    f"fleet restart of {nid} was not acknowledged",
+                    stacklevel=2,
+                )
 
     def _ingest_report(self, nid: str, reply: Ack) -> None:
         try:
@@ -341,6 +364,35 @@ class FleetRouter:
                 EVENT_FLEET_REPLICA_UP, replica=nid, port=st.port,
                 round=st.loaded_round,
             )
+        # restart triggers (ISSUE 19): a consecutive compile-growth streak
+        # or an HBM-growth-degraded serve plane marks the replica for a
+        # soft restart. The AUTOPILOT owns the decision (per-replica
+        # cooldown + decision event); execution waits for _drain_restarts
+        ap = telemetry.autopilot_active()
+        if ap is not None:
+            reason = None
+            observed = 1.0
+            compiles = rep.get("compiles")
+            if compiles is not None:
+                prev = self._compiles.get(nid)
+                self._compiles[nid] = float(compiles)
+                streak = (
+                    self._compile_streaks.get(nid, 0) + 1
+                    if prev is not None and float(compiles) > prev
+                    else 0
+                )
+                self._compile_streaks[nid] = streak
+                limit = int(getattr(ap.cfg, "replica_compile_streak", 0))
+                if limit > 0 and streak >= limit:
+                    reason, observed = "compile_growth", float(streak)
+            health = rep.get("health") or {}
+            if health.get("status") not in (None, "ok") \
+                    and health.get("reason") == ALERT_HBM_GROWTH:
+                reason, observed = ALERT_HBM_GROWTH, 1.0
+            if reason is not None and ap.request_replica_restart(
+                    nid, reason, observed=observed):
+                self._compile_streaks[nid] = 0
+                self._pending_restarts.append(nid)
 
     def _apply_transitions(self) -> None:
         """Edge-detect the tracker states: a replica newly DEAD re-pins

@@ -49,11 +49,14 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from photon_tpu import telemetry
+from photon_tpu import chaos, telemetry
 from photon_tpu.analysis.runtime import steady_point
 from photon_tpu.metrics.history import History
 from photon_tpu.serve.engine import PagedEngine
 from photon_tpu.utils.profiling import (
+    AUTOPILOT_ACTION_RECLAIM,
+    AUTOPILOT_KNOB_PREFILL_BUDGET,
+    AUTOPILOT_KNOB_SPEC_K_MAX,
     EVENT_HOTSWAP_SWAPPED,
     SERVE_ADAPTER_COHORTS,
     SERVE_ADAPTER_EVICTIONS,
@@ -231,6 +234,22 @@ class ContinuousBatcher:
         #: device-plane introspection cadence: HBM/compile stats are
         #: sampled every N scheduler ticks, not every tick
         self.device_sample_ticks = 64
+        # SLO autopilot knobs (ISSUE 19): registered at construction so the
+        # controller only ever drives a batcher that actually exists; the
+        # current values become the declared optima relax probes toward
+        ap = telemetry.autopilot_active()
+        if ap is not None:
+            ap.register_knob(AUTOPILOT_KNOB_PREFILL_BUDGET,
+                             lambda: self.prefill_token_budget,
+                             self.set_prefill_token_budget, integer=True)
+            if self._spec is not None:
+                ap.register_knob(AUTOPILOT_KNOB_SPEC_K_MAX,
+                                 lambda: self._spec.k_max,
+                                 self._spec.set_k_max, integer=True)
+            ap.register_action(
+                AUTOPILOT_ACTION_RECLAIM,
+                lambda: self.reclaim_memory(int(ap.cfg.reclaim_free_blocks)),
+            )
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "ContinuousBatcher":
@@ -282,6 +301,75 @@ class ContinuousBatcher:
             time.sleep(0.01)
         self.close()
         return drained
+
+    # -- runtime-mutable knobs + actuators (ISSUE 19) ----------------------
+    def set_prefill_token_budget(self, budget: int) -> None:
+        """Runtime-mutable chunk budget: the SLO autopilot shrinks this
+        under queue saturation (cheaper ticks → decode keeps its cadence
+        while the backlog drains) and probes it back toward the declared
+        value when the breach clears. One lock acquisition; out-of-range
+        values are rejected loudly, never clamped silently."""
+        b = int(budget)
+        if b < 1:
+            raise ValueError(
+                f"prefill_token_budget must be >= 1, got {budget}"
+            )
+        with self._lock:
+            self.prefill_token_budget = b
+
+    def reclaim_memory(self, min_free_blocks: int = 8) -> tuple[float, float]:
+        """HBM-pressure actuator: evict unpinned prefix-cache entries until
+        the paged pool covers ``min_free_blocks``, then shrink the adapter
+        pool's unpinned LRU residents. Safe under live traffic — both paths
+        skip anything a running slot still references. Returns the pool's
+        ``(free_blocks_before, free_blocks_after)`` for the decision
+        record."""
+        eng = self.engine
+        alloc = getattr(eng, "allocator", None)
+        before = float(alloc.free_blocks) if alloc is not None else 0.0
+        pc = getattr(eng, "prefix_cache", None)
+        if pc is not None:
+            pc.ensure_free(int(min_free_blocks))
+        pool = getattr(eng, "adapter_pool", None)
+        if pool is not None:
+            pool.shrink()
+        after = float(alloc.free_blocks) if alloc is not None else before
+        return before, after
+
+    def recycle(self, timeout_s: float = 30.0) -> bool:
+        """Soft restart (the fleet autopilot's "drain and restart" leg):
+        pause admission, wait — bounded — for queued and running work to
+        finish, reclaim engine caches (prefix flush + adapter LRU shrink),
+        then resume admission. Unlike :meth:`drain` the driver thread
+        KEEPS RUNNING, so the replica re-enters rotation without a process
+        restart. Returns True when the engine fully quiesced inside the
+        bound (the cache reclaim happens either way: both paths are safe
+        against pinned state)."""
+        with self._work:
+            if self._stop:
+                return False
+            self._draining = True
+            self._work.notify_all()
+        deadline = time.monotonic() + timeout_s
+        idle = False
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._queue and not self._running:
+                    idle = True
+                    break
+            time.sleep(0.01)
+        try:
+            pc = getattr(self.engine, "prefix_cache", None)
+            if pc is not None:
+                pc.flush()
+            pool = getattr(self.engine, "adapter_pool", None)
+            if pool is not None:
+                pool.shrink()
+        finally:
+            with self._work:
+                self._draining = False
+                self._work.notify_all()
+        return idle
 
     # -- live checkpoint hot-swap (ISSUE 11) ------------------------------
     def request_swap(self, params, loaded_round: int | None = None,
@@ -600,6 +688,16 @@ class ContinuousBatcher:
                 sum(max(0, int(n_em[s]) - 1) for s in drafts),
             )
         dt = time.monotonic() - t0
+        # chaos serve storm (ISSUE 19): a deterministic per-token stall
+        # amplifies the compute-proportional cost of this tick, so the
+        # autopilot's budget shrink measurably protects decode cadence
+        inj = chaos.active()
+        if inj is not None:
+            stall = inj.serve_stall_plan(
+                (chunk[1] if chunk else 0) + sum(int(x) for x in n_em)
+            )
+            if stall > 0.0:
+                time.sleep(stall)
         n_tokens = 0
         for slot in sorted(running):
             n = int(n_em[slot])
@@ -783,12 +881,25 @@ class ContinuousBatcher:
                 max_queue=self.max_queue,
             )
             hbm = stats.get(SERVE_HBM_BYTES_IN_USE)
+            # chaos HBM-pressure ramp (ISSUE 19): strictly-monotone
+            # inflation of the sample (synthesized when the backend
+            # reports none) so the growth watcher latches deterministically
+            inj = chaos.active()
+            if inj is not None:
+                ramp = inj.hbm_ramp_plan()
+                if ramp > 0.0:
+                    hbm = (hbm if hbm is not None else 1.0) * (1.0 + ramp)
             if hbm is not None:
                 health.note_hbm_sample(hbm, plane="serve")
         self.history.record(self._tick, stats)
         for series in self.history.rounds.values():
             if len(series) > self.max_kpi_ticks:
                 del series[: len(series) - self.max_kpi_ticks]
+        # SLO autopilot (ISSUE 19): the serve plane's evaluation point —
+        # one None check when disabled, a period-gated rule sweep when on
+        ap = telemetry.autopilot_active()
+        if ap is not None:
+            ap.tick("serve", max_queue=self.max_queue)
 
     def _observe_request(self, req: ServeRequest, ctx: tuple | None,
                          error: str | None) -> None:

@@ -48,6 +48,7 @@ __all__ = [
     "Tracer",
     "active",
     "attach",
+    "autopilot_active",
     "current_context",
     "drain_events",
     "emit_event",
@@ -72,6 +73,7 @@ _EVENTS: EventLog | None = None
 _METRICS: MetricsHub | None = None
 _HEALTH: HealthMonitor | None = None
 _PROFILER: ProfileController | None = None
+_AUTOPILOT = None  # telemetry.autopilot.Autopilot | None (lazy import)
 
 #: shared do-nothing context manager — the disabled-path ``span()`` return
 #: value, allocated once so the hook sites stay allocation-free
@@ -95,7 +97,7 @@ def install(cfg, scope: str = "", events_path: str | None = None,
     on-demand ``jax.profiler`` artifacts land (defaults to ``cfg.dir`` or
     the events file's directory).
     """
-    global _TRACER, _EVENTS, _METRICS, _HEALTH, _PROFILER
+    global _TRACER, _EVENTS, _METRICS, _HEALTH, _PROFILER, _AUTOPILOT
     if cfg is None or not getattr(cfg, "enabled", False):
         uninstall()
         return None
@@ -114,6 +116,15 @@ def install(cfg, scope: str = "", events_path: str | None = None,
         _PROFILER.close()
     _PROFILER = ProfileController(profile_dir)
     introspect.install_compile_counter()
+    # SLO autopilot (ISSUE 19): installed with the plane it subscribes to;
+    # subsystems register their knobs against it as they construct
+    ap_cfg = getattr(cfg, "autopilot", None)
+    if ap_cfg is not None and getattr(ap_cfg, "enabled", False):
+        from photon_tpu.telemetry.autopilot import Autopilot
+
+        _AUTOPILOT = Autopilot(ap_cfg)
+    else:
+        _AUTOPILOT = None
     # span-drop accounting (ISSUE 10 satellite): the bounded buffer's
     # discards feed a counter, and the FIRST drop of the run emits one
     # warning event — observability of the observability
@@ -133,7 +144,7 @@ def install(cfg, scope: str = "", events_path: str | None = None,
 
 
 def uninstall() -> None:
-    global _TRACER, _EVENTS, _METRICS, _HEALTH, _PROFILER
+    global _TRACER, _EVENTS, _METRICS, _HEALTH, _PROFILER, _AUTOPILOT
     if _EVENTS is not None:
         _EVENTS.close()
     if _PROFILER is not None:
@@ -144,6 +155,7 @@ def uninstall() -> None:
     _METRICS = None
     _HEALTH = None
     _PROFILER = None
+    _AUTOPILOT = None
 
 
 def active() -> Tracer | None:
@@ -166,6 +178,11 @@ def health_active() -> HealthMonitor | None:
 
 def profiler_active() -> ProfileController | None:
     return _PROFILER
+
+
+def autopilot_active():
+    """The installed SLO autopilot, or None (one check per hook site)."""
+    return _AUTOPILOT
 
 
 # -- hook-site helpers (each is a None check when disabled) ---------------

@@ -105,6 +105,71 @@ class _Instrument:
             vals = [v for _, v in self._ring]
         return vals if n is None else vals[-n:]
 
+    # -- windowed reducers (ISSUE 19): the controller-facing view ----------
+    # Every consumer used to re-derive these from series() ad hoc; the SLO
+    # autopilot needs one shared, tested vocabulary of reductions.
+    def _window(self, window_s: float | None) -> list[tuple[float, float]]:
+        """Retained samples, trimmed to the trailing ``window_s`` seconds
+        (all of them when None)."""
+        with self._lock:
+            samples = list(self._ring)
+        if window_s is None:
+            return samples
+        cut = self._clock() - float(window_s)
+        return [(t, v) for t, v in samples if t >= cut]
+
+    def latest(self) -> float | None:
+        with self._lock:
+            return self._ring[-1][1] if self._ring else None
+
+    def percentile(self, q: float, window_s: float | None = None) -> float | None:
+        """q-th percentile (nearest-rank) over the RETAINED samples — the
+        ring, not any full-history state — optionally restricted to the
+        trailing ``window_s`` seconds. None when the window is empty."""
+        vals = sorted(v for _, v in self._window(window_s))
+        if not vals:
+            return None
+        q = min(1.0, max(0.0, q))
+        return vals[min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))]
+
+    def rate(self, window_s: float | None = None) -> float | None:
+        """Per-second change between the window's first and last samples
+        (the Prometheus ``rate()`` shape for counters; a net drift for
+        gauges). None with fewer than two samples or a zero timespan."""
+        s = self._window(window_s)
+        if len(s) < 2 or s[-1][0] <= s[0][0]:
+            return None
+        return (s[-1][1] - s[0][1]) / (s[-1][0] - s[0][0])
+
+    def slope(self, window_s: float | None = None) -> float | None:
+        """Least-squares trend (value units per second) over the window —
+        noise-robust where :meth:`rate` keys on two endpoint samples.
+        None with fewer than two samples or zero time variance."""
+        s = self._window(window_s)
+        if len(s) < 2:
+            return None
+        n = len(s)
+        t0 = s[0][0]
+        ts = [t - t0 for t, _ in s]
+        vs = [v for _, v in s]
+        mt = sum(ts) / n
+        mv = sum(vs) / n
+        var = sum((t - mt) ** 2 for t in ts)
+        if var <= 0:
+            return None
+        return sum((t - mt) * (v - mv) for t, v in zip(ts, vs)) / var
+
+    def ewma(self, alpha: float = 0.2, window_s: float | None = None) -> float | None:
+        """Exponentially-weighted moving average over the window, seeded
+        from the window's first sample. None when the window is empty."""
+        s = self._window(window_s)
+        if not s:
+            return None
+        acc = s[0][1]
+        for _, v in s[1:]:
+            acc += alpha * (v - acc)
+        return acc
+
     def render(self, exemplars: bool = True) -> list[str]:  # pragma: no cover
         raise NotImplementedError
 
@@ -210,15 +275,6 @@ class Histogram(_Instrument):
                     trace_id=str(exemplar[0]),
                     span_id=str(exemplar[1]) if len(exemplar) > 1 else "",
                 )
-
-    def percentile(self, q: float) -> float | None:
-        """q-th percentile over the RETAINED observations (the ring, not
-        the full-history buckets) — the health watchers' straggler view."""
-        vals = sorted(self.recent_values())
-        if not vals:
-            return None
-        q = min(1.0, max(0.0, q))
-        return vals[min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))]
 
     def render(self, exemplars: bool = True) -> list[str]:
         """``exemplars=False`` renders classic text format v0.0.4 (legacy

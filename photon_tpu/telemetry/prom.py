@@ -37,6 +37,7 @@ import time
 import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from photon_tpu import telemetry
 from photon_tpu.telemetry.introspect import ProfileBusyError
 from photon_tpu.telemetry.metrics import metric_name
 
@@ -195,6 +196,9 @@ class PromServer:
                     payload = (h.statusz() if h is not None
                                else {"status": "ok", "planes": {},
                                      "alerts": [], "telemetry": "off"})
+                    ap = telemetry.autopilot_active()
+                    if ap is not None:
+                        payload["autopilot"] = ap.statusz()
                     self._json(200, payload)
                 else:
                     self._not_found()

@@ -397,6 +397,39 @@ EVENT_ASYNC_DROP = "async/delta_dropped"
 #: version clock held (stall-not-abort; attrs: buffered, distinct)
 EVENT_ASYNC_STALL = "async/min_arrivals_stall"
 
+# -- SLO autopilot (ISSUE 19, telemetry/autopilot.py) ----------------------
+# Every controller decision is an event carrying the rule that fired, the
+# observed metric value, and the old/new knob values — the audit trail the
+# chaos storm e2e and /statusz both read.
+#: a rule breached its target and tightened its knob (attrs: rule, knob,
+#: observed, old, new)
+EVENT_AUTOPILOT_ACTUATION = "autopilot/actuation"
+#: a rule's breach cleared for relax_after evaluations and the knob probed
+#: back toward the subsystem's declared value (same attrs)
+EVENT_AUTOPILOT_RELAX = "autopilot/relax"
+#: a breach persisted but the knob was already at its bound — emitted once
+#: per saturation episode, never repeated per evaluation
+EVENT_AUTOPILOT_SATURATED = "autopilot/saturated"
+#: knob-id gauges (the autopilot mirrors every knob it owns into the hub
+#: so dashboards can overlay actuations on the metrics that drove them):
+AUTOPILOT_KNOB_PREFILL_BUDGET = "serve/prefill_token_budget"
+AUTOPILOT_KNOB_SPEC_K_MAX = "serve/spec_k_max"
+AUTOPILOT_KNOB_STAGE_TIMEOUT_S = "server/collective_stage_timeout_s"
+AUTOPILOT_KNOB_QUANT_LEVEL = "server/collective_quantization_level"
+AUTOPILOT_KNOB_MAX_STALENESS = "server/async_max_staleness"
+#: one-shot actions (no continuous knob value; the event's old/new carry
+#: the action's before/after observation, e.g. free blocks):
+AUTOPILOT_ACTION_RECLAIM = "serve/memory_reclaim"
+AUTOPILOT_ACTION_RESTART = "fleet/restart_replica"
+#: controller KPI counters/gauges:
+AUTOPILOT_ACTUATIONS = "server/autopilot_actuations_total"
+AUTOPILOT_RELAXES = "server/autopilot_relaxes_total"
+AUTOPILOT_SATURATIONS = "server/autopilot_saturations_total"
+AUTOPILOT_RULES_BREACHED = "server/autopilot_rules_breached"
+#: per-round straggler fraction mirrored into the hub at the collective
+#: tick site (the series the straggler_deadline rule takes its p90 over)
+COLLECTIVE_STRAGGLER_FRAC = "server/collective_straggler_frac"
+
 # -- structured alert kinds (telemetry/health.py, ISSUE 10) ---------------
 # Health watchers emit these as events (same registry discipline) AND
 # record them on the monitor's alert tail rolled up into /statusz.

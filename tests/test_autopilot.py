@@ -1,0 +1,601 @@
+"""SLO autopilot (ISSUE 19): reducers, knobs, controller, storm e2e.
+
+Four layers of contract:
+
+1. the windowed reducers on every hub instrument (``percentile``,
+   ``rate``, ``slope``, ``ewma``) pin EXACT values on hand-built series
+   under an injected clock;
+2. every runtime-mutable knob rejects out-of-range values loudly
+   (budget, spec K ceiling, stage timeout, staleness bound) and the
+   pressure-safe reclaim paths skip pinned state;
+3. the controller itself — breach → actuate once per cooldown,
+   hysteresis relax toward the declared optimum, one ``saturated`` per
+   episode, the enum quantization ladder, async reject-rate widening,
+   the HBM alert-latch reclaim, per-replica restart cooldown — all on an
+   injected clock, with every decision on the ring + event log;
+4. a seeded chaos storm through the REAL scheduler (slow-marked): the
+   controller must shrink the prefill budget under induced queue
+   saturation and surface the decision at /statusz.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from photon_tpu import chaos, telemetry
+from photon_tpu.config.schema import (
+    AutopilotConfig,
+    ChaosConfig,
+    Config,
+    TelemetryConfig,
+)
+from photon_tpu.telemetry.autopilot import Autopilot
+from photon_tpu.telemetry.health import HealthMonitor
+from photon_tpu.telemetry.metrics import MetricsHub
+from photon_tpu.utils.profiling import (
+    ALERT_HBM_GROWTH,
+    AUTOPILOT_ACTION_RECLAIM,
+    AUTOPILOT_ACTION_RESTART,
+    AUTOPILOT_KNOB_MAX_STALENESS,
+    AUTOPILOT_KNOB_PREFILL_BUDGET,
+    AUTOPILOT_KNOB_QUANT_LEVEL,
+    COLLECTIVE_WIRE_BYTES,
+    EVENT_AUTOPILOT_ACTUATION,
+    EVENT_AUTOPILOT_RELAX,
+    EVENT_AUTOPILOT_SATURATED,
+    SERVE_QUEUE_DEPTH,
+)
+
+
+class _Clock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    telemetry.uninstall()
+    chaos.uninstall()
+    yield
+    telemetry.uninstall()
+    chaos.uninstall()
+
+
+def _install(clk, **ap_kw):
+    """Telemetry plane + injected-clock hub/health + a controller built on
+    the same clock (the config-install path is covered separately)."""
+    telemetry.install(TelemetryConfig(enabled=True), scope="t")
+    telemetry._METRICS = MetricsHub(clock=clk)
+    telemetry._HEALTH = HealthMonitor(clock=clk)
+    ap = Autopilot(AutopilotConfig(enabled=True, **ap_kw), clock=clk)
+    telemetry._AUTOPILOT = ap
+    return ap
+
+
+def _event_kinds():
+    return [e["kind"] for e in telemetry.drain_events()]
+
+
+# ---------------------------------------------------------------------------
+# 1. windowed reducers — exact values on hand-built series
+# ---------------------------------------------------------------------------
+
+
+def test_percentile_nearest_rank_exact():
+    clk = _Clock(0.0)
+    hub = MetricsHub(clock=clk)
+    g = hub.gauge(SERVE_QUEUE_DEPTH)
+    for i in range(10):
+        clk.t = float(i)
+        g.set(float(i))
+    clk.t = 9.0
+    assert g.percentile(0.0) == 0.0
+    assert g.percentile(0.5) == 5.0  # int(0.5*9 + 0.5) == 5
+    assert g.percentile(0.9) == 8.0  # int(0.9*9 + 0.5) == 8
+    assert g.percentile(1.0) == 9.0
+    # trailing 4.5 s window keeps ts >= 4.5 → values 5..9, p50 == 7
+    assert g.percentile(0.5, window_s=4.5) == 7.0
+
+
+def test_rate_endpoint_delta_exact():
+    clk = _Clock(0.0)
+    hub = MetricsHub(clock=clk)
+    c = hub.counter(COLLECTIVE_WIRE_BYTES)
+    c.inc(0.0)
+    clk.t = 10.0
+    c.inc(50.0)
+    assert c.rate() == 5.0
+    assert c.latest() == 50.0
+
+
+def test_slope_least_squares_exact():
+    clk = _Clock(0.0)
+    hub = MetricsHub(clock=clk)
+    g = hub.gauge(SERVE_QUEUE_DEPTH)
+    for i in range(5):
+        clk.t = float(i)
+        g.set(1.0 + 2.0 * i)  # exact line: slope must be exactly 2
+    assert g.slope() == 2.0
+    # a window catching only the last two samples sees the same line
+    assert g.slope(window_s=1.0) == 2.0
+
+
+def test_ewma_seeded_from_first_sample():
+    clk = _Clock(0.0)
+    hub = MetricsHub(clock=clk)
+    g = hub.gauge(SERVE_QUEUE_DEPTH)
+    g.set(0.0)
+    clk.t = 1.0
+    g.set(10.0)
+    assert g.ewma(alpha=0.5) == 5.0  # 0 + 0.5*(10-0)
+    # window that excludes the first sample re-seeds from the second
+    assert g.ewma(alpha=0.5, window_s=0.5) == 10.0
+
+
+def test_reducers_empty_and_degenerate_windows_are_none():
+    clk = _Clock(0.0)
+    hub = MetricsHub(clock=clk)
+    g = hub.gauge(SERVE_QUEUE_DEPTH)
+    assert g.latest() is None
+    assert g.percentile(0.5) is None
+    assert g.rate() is None
+    assert g.slope() is None
+    assert g.ewma() is None
+    g.set(3.0)
+    assert g.rate() is None  # one sample: no timespan
+    assert g.slope() is None
+    g.set(4.0)
+    assert g.rate() is None  # zero timespan between samples
+    assert g.slope() is None  # zero time variance
+
+
+# ---------------------------------------------------------------------------
+# 2. runtime-mutable knobs — loud rejects, pressure-safe reclaim
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_budget_setter_rejects_below_one():
+    from photon_tpu.serve.scheduler import ContinuousBatcher
+
+    b = object.__new__(ContinuousBatcher)
+    b._lock = threading.Lock()
+    b.prefill_token_budget = 64
+    b.set_prefill_token_budget(8)
+    assert b.prefill_token_budget == 8
+    with pytest.raises(ValueError, match=">= 1"):
+        b.set_prefill_token_budget(0)
+    assert b.prefill_token_budget == 8  # reject leaves the knob untouched
+
+
+def test_spec_controller_k_max_zero_silences_probe():
+    from photon_tpu.serve.draft import SpecController
+
+    sc = SpecController(4, probe_ticks=2)
+    with pytest.raises(ValueError, match=">= 0"):
+        sc.set_k_max(-1)
+    sc.set_k_max(0)
+    assert sc.k_effective() == 0
+    # the periodic probe is clamped to the ceiling: fully off stays off
+    assert [sc.next_k() for _ in range(5)] == [0, 0, 0, 0, 0]
+    sc.set_k_max(2)
+    assert sc.k_effective() == 2  # optimistic EWMA re-engages immediately
+
+
+def test_collective_setters_loud_rejects():
+    from photon_tpu.federation.collective_round import CollectiveFedRunner
+
+    r = object.__new__(CollectiveFedRunner)
+    r.stage_timeout_s = 30.0
+    r.set_stage_timeout_s(10.0)
+    assert r.stage_timeout_s == 10.0
+    for bad in (0.0, -1.0, float("nan"), float("inf")):
+        with pytest.raises(ValueError):
+            r.set_stage_timeout_s(bad)
+    assert r.stage_timeout_s == 10.0
+    r.quantization = "off"
+    r.device_plane = None
+    r.set_quantization("q8")
+    assert r.quantization == "q8"
+    r.set_quantization("q8")  # idempotent no-op
+    with pytest.raises(ValueError, match="unknown collective quantization"):
+        r.set_quantization("int4")
+
+
+def test_async_staleness_setter_rejects_negative():
+    from photon_tpu.federation.async_round import AsyncFedRunner
+
+    r = object.__new__(AsyncFedRunner)
+    r.max_staleness = 4
+    r.set_max_staleness(0)  # 0 is legal: only same-version deltas fold
+    assert r.max_staleness == 0
+    with pytest.raises(ValueError, match=">= 0"):
+        r.set_max_staleness(-1)
+
+
+def test_adapter_pool_shrink_skips_pinned_pages():
+    from photon_tpu.adapters.lora import AdapterSpec
+    from photon_tpu.serve.adapter_pool import AdapterPool
+
+    spec = AdapterSpec(
+        rank=2, alpha=4.0,
+        entries=(("blocks/block/up_proj", (4, 2), (2, 4)),),
+    )
+    pool = AdapterPool(spec, pool_size=2)
+    pool.install_bank({
+        c: [np.zeros((4, 2), np.float32), np.zeros((2, 4), np.float32)]
+        for c in ("a", "b")
+    })
+    pa = pool.acquire("a")          # pinned by a live slot
+    pool.release(pool.acquire("b"))  # resident, unpinned
+    assert pool.shrink() == 1        # only b is evictable
+    assert pool.has_cohort("a") and pool.can_acquire("a")
+    assert "b" not in pool._pages and "a" in pool._pages
+    pool.release(pa)                 # the pin is still valid after shrink
+    assert pool.shrink() == 1        # a unpins → evictable now
+
+
+# ---------------------------------------------------------------------------
+# 3. the controller, on an injected clock
+# ---------------------------------------------------------------------------
+
+
+def test_breach_actuates_once_per_cooldown_then_saturates_once():
+    clk = _Clock(100.0)
+    ap = _install(clk, period_s=0.25, cooldown_s=2.0,
+                  queue_high_frac=0.75, queue_clear_frac=0.25,
+                  prefill_budget_min=16, prefill_shrink=0.5)
+    state = {"budget": 64}
+    ap.register_knob(AUTOPILOT_KNOB_PREFILL_BUDGET,
+                     lambda: state["budget"],
+                     lambda v: state.__setitem__("budget", v), integer=True)
+    hub = telemetry.metrics_active()
+    hub.gauge(SERVE_QUEUE_DEPTH).set(100.0)  # frac 1.0 ≥ 0.75: breach
+    telemetry.drain_events()
+
+    ap.tick("serve", max_queue=100)
+    assert state["budget"] == 32
+    kinds = _event_kinds()
+    assert kinds.count(EVENT_AUTOPILOT_ACTUATION) == 1
+    d = ap.decisions[-1]
+    assert d["rule"] == "queue_budget"
+    assert d["knob"] == AUTOPILOT_KNOB_PREFILL_BUDGET
+    assert (d["old"], d["new"]) == (64, 32)
+    assert d["observed"] == 1.0
+
+    clk.advance(0.3)  # period elapsed, cooldown NOT
+    ap.tick("serve", max_queue=100)
+    assert state["budget"] == 32
+
+    clk.advance(2.0)  # cooldown elapsed: one more shrink, to the bound
+    ap.tick("serve", max_queue=100)
+    assert state["budget"] == 16
+
+    clk.advance(2.1)  # at the bound: saturated, exactly once per episode
+    ap.tick("serve", max_queue=100)
+    clk.advance(2.1)
+    ap.tick("serve", max_queue=100)
+    assert state["budget"] == 16
+    sat = [d for d in ap.decisions
+           if d["event"] == EVENT_AUTOPILOT_SATURATED]
+    assert len(sat) == 1
+    assert ap.statusz()["rules"]["queue_budget"]["saturated"] is True
+
+
+def test_hysteresis_relax_probes_back_toward_declared():
+    clk = _Clock(0.0)
+    ap = _install(clk, period_s=0.25, cooldown_s=0.5, relax_after=3,
+                  window_s=30.0, queue_high_frac=0.75,
+                  queue_clear_frac=0.25, prefill_budget_min=16,
+                  prefill_shrink=0.5)
+    state = {"budget": 64}
+    ap.register_knob(AUTOPILOT_KNOB_PREFILL_BUDGET,
+                     lambda: state["budget"],
+                     lambda v: state.__setitem__("budget", v), integer=True)
+    hub = telemetry.metrics_active()
+    hub.gauge(SERVE_QUEUE_DEPTH).set(100.0)
+    ap.tick("serve", max_queue=100)
+    assert state["budget"] == 32
+
+    # age the saturated samples out of the window, then run clean evals
+    clk.advance(31.0)
+    for i in range(3):
+        hub.gauge(SERVE_QUEUE_DEPTH).set(5.0)  # frac 0.05 ≤ 0.25: clean
+        ap.tick("serve", max_queue=100)
+        clk.advance(0.3)
+    # third consecutive clean eval relaxes one integer step toward 64
+    assert state["budget"] == 33
+    relax = [d for d in ap.decisions if d["event"] == EVENT_AUTOPILOT_RELAX]
+    assert len(relax) == 1
+    assert (relax[0]["old"], relax[0]["new"]) == (32, 33)
+
+
+def test_dead_band_neither_tightens_nor_earns_relax_credit():
+    clk = _Clock(0.0)
+    ap = _install(clk, period_s=0.1, cooldown_s=0.0, relax_after=2,
+                  window_s=1.0, queue_high_frac=0.75, queue_clear_frac=0.25,
+                  prefill_budget_min=16, prefill_shrink=0.5)
+    state = {"budget": 64}
+    ap.register_knob(AUTOPILOT_KNOB_PREFILL_BUDGET,
+                     lambda: state["budget"],
+                     lambda v: state.__setitem__("budget", v), integer=True)
+    hub = telemetry.metrics_active()
+    hub.gauge(SERVE_QUEUE_DEPTH).set(100.0)
+    ap.tick("serve", max_queue=100)
+    assert state["budget"] == 32
+    for _ in range(4):  # frac 0.5 sits between clear 0.25 and breach 0.75
+        clk.advance(1.1)
+        hub.gauge(SERVE_QUEUE_DEPTH).set(50.0)
+        ap.tick("serve", max_queue=100)
+    assert state["budget"] == 32  # no tighten, no relax
+    assert ap.statusz()["rules"]["queue_budget"]["clean_streak"] == 0
+
+
+def test_quantization_enum_escalates_then_saturates():
+    clk = _Clock(0.0)
+    ap = _install(clk, period_s=0.1, cooldown_s=0.0,
+                  wire_slope_bytes_per_s=10.0)
+    state = {"q": "off"}
+    ap.register_knob(AUTOPILOT_KNOB_QUANT_LEVEL,
+                     lambda: state["q"],
+                     lambda v: state.__setitem__("q", v),
+                     levels=("off", "q8"))
+    hub = telemetry.metrics_active()
+    c = hub.counter(COLLECTIVE_WIRE_BYTES)
+    c.inc(1.0)
+    clk.advance(1.0)
+    c.inc(100.0)  # slope ≈ 100 B/s > 10
+    ap.tick("collective")
+    assert state["q"] == "q8"
+    d = [d for d in ap.decisions
+         if d["event"] == EVENT_AUTOPILOT_ACTUATION][-1]
+    assert (d["old"], d["new"]) == ("off", "q8")
+    clk.advance(0.2)
+    c.inc(100.0)
+    ap.tick("collective")  # still breaching at the ladder's top
+    assert state["q"] == "q8"
+    assert any(d["event"] == EVENT_AUTOPILOT_SATURATED
+               for d in ap.decisions)
+
+
+def test_async_reject_rate_widens_staleness_bound():
+    clk = _Clock(0.0)
+    ap = _install(clk, period_s=0.1, cooldown_s=0.0,
+                  async_reject_per_version=0.5, max_staleness_hi=8)
+    state = {"s": 2}
+    ap.register_knob(AUTOPILOT_KNOB_MAX_STALENESS,
+                     lambda: state["s"],
+                     lambda v: state.__setitem__("s", v), integer=True)
+    ap.tick("async", rejected_total=0, version=1)  # primes the delta
+    assert state["s"] == 2
+    clk.advance(0.2)
+    ap.tick("async", rejected_total=3, version=2)  # 3 rejects/version
+    assert state["s"] == 3
+    # bounds: declared is the floor, max_staleness_hi the ceiling
+    z = ap.statusz()["knobs"][AUTOPILOT_KNOB_MAX_STALENESS]
+    assert (z["lo"], z["hi"]) == (2.0, 8.0)
+
+
+def test_hbm_alert_latch_fires_reclaim_once_per_alert():
+    clk = _Clock(50.0)
+    ap = _install(clk, period_s=0.1, cooldown_s=0.0)
+    calls = []
+    ap.register_action(AUTOPILOT_ACTION_RECLAIM,
+                       lambda: (calls.append(1), (10.0, 26.0))[1])
+    health = telemetry.health_active()
+    health.alert(ALERT_HBM_GROWTH, plane="serve", growth_frac=0.4)
+    ap.tick("serve", max_queue=8)
+    assert calls == [1]
+    d = ap.decisions[-1]
+    assert d["rule"] == "hbm_reclaim"
+    assert d["knob"] == AUTOPILOT_ACTION_RECLAIM
+    assert (d["old"], d["new"]) == (10.0, 26.0)
+    assert d["observed"] == 0.4
+    clk.advance(0.2)
+    ap.tick("serve", max_queue=8)  # same alert: no second reclaim
+    assert calls == [1]
+    clk.advance(0.2)
+    health.alert(ALERT_HBM_GROWTH, plane="serve", growth_frac=0.6)
+    ap.tick("serve", max_queue=8)  # a NEW alert fires again
+    assert calls == [1, 1]
+
+
+def test_replica_restart_cooldown_is_per_replica():
+    clk = _Clock(0.0)
+    ap = _install(clk, cooldown_s=5.0)
+    assert ap.request_replica_restart("r0", "compile_growth") is True
+    assert ap.request_replica_restart("r0", "compile_growth") is False
+    assert ap.request_replica_restart("r1", ALERT_HBM_GROWTH) is True
+    clk.advance(6.0)
+    assert ap.request_replica_restart("r0", "compile_growth") is True
+    d = ap.decisions[-1]
+    assert d["knob"] == AUTOPILOT_ACTION_RESTART
+    assert (d["old"], d["new"]) == ("live", "restarting")
+    assert d["replica"] == "r0"
+
+
+def test_tick_never_raises_out_of_the_hook_site():
+    clk = _Clock(0.0)
+    ap = _install(clk, period_s=0.1, cooldown_s=0.0)
+
+    def _bad_setter(v):
+        raise RuntimeError("actuator wired wrong")
+
+    ap.register_knob(AUTOPILOT_KNOB_PREFILL_BUDGET,
+                     lambda: 64, _bad_setter, integer=True)
+    hub = telemetry.metrics_active()
+    hub.gauge(SERVE_QUEUE_DEPTH).set(100.0)
+    with pytest.warns(UserWarning, match="autopilot tick failed"):
+        ap.tick("serve", max_queue=100)
+
+
+def test_install_path_and_disabled_is_one_none_check():
+    tel = TelemetryConfig(enabled=True)
+    telemetry.install(tel, scope="t")
+    assert telemetry.autopilot_active() is None  # autopilot default off
+    tel.autopilot.enabled = True
+    telemetry.install(tel, scope="t")
+    ap = telemetry.autopilot_active()
+    assert ap is not None
+    assert {r.name for r in ap._rules} == {"queue_budget", "hbm_reclaim"}
+    telemetry.uninstall()
+    assert telemetry.autopilot_active() is None
+
+
+def test_config_validation_rejects_bad_autopilot_blocks():
+    cfg = Config()
+    cfg.photon.telemetry.enabled = True
+    cfg.photon.telemetry.autopilot.enabled = True
+    cfg.validate()  # defaults are legal
+    cfg.photon.telemetry.autopilot.period_s = 0.0
+    with pytest.raises(ValueError):
+        cfg.validate()
+    cfg.photon.telemetry.autopilot.period_s = 0.25
+    cfg.photon.telemetry.autopilot.queue_clear_frac = 0.9  # ≥ high_frac
+    with pytest.raises(ValueError):
+        cfg.validate()
+    cfg.photon.telemetry.autopilot.queue_clear_frac = 0.25
+    cfg.photon.telemetry.enabled = False  # autopilot needs the plane
+    with pytest.raises(ValueError):
+        cfg.validate()
+
+
+def test_statusz_surfaces_decisions_rules_and_knob_bounds():
+    clk = _Clock(0.0)
+    ap = _install(clk, prefill_budget_min=16)
+    state = {"budget": 64}
+    ap.register_knob(AUTOPILOT_KNOB_PREFILL_BUDGET,
+                     lambda: state["budget"],
+                     lambda v: state.__setitem__("budget", v), integer=True)
+    z = ap.statusz()
+    assert set(z) == {"decisions", "rules", "knobs"}
+    k = z["knobs"][AUTOPILOT_KNOB_PREFILL_BUDGET]
+    assert (k["value"], k["declared"], k["lo"], k["hi"]) == (64, 64, 16.0, 64.0)
+    assert set(z["rules"]) == {"queue_budget", "hbm_reclaim"}
+
+
+def test_prom_statusz_merges_autopilot_payload():
+    import json
+    import urllib.request
+
+    from photon_tpu.metrics.history import History
+    from photon_tpu.telemetry.prom import PromServer
+
+    clk = _Clock(0.0)
+    ap = _install(clk)
+    srv = PromServer(History(), port=0,
+                     hub=telemetry.metrics_active(),
+                     health=telemetry.health_active())
+    srv.start()
+    try:
+        z = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/statusz", timeout=5
+        ).read())
+        assert set(z["autopilot"]) == {"decisions", "rules", "knobs"}
+        assert "queue_budget" in z["autopilot"]["rules"]
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# 4. seeded chaos storm through the real scheduler (slow: engine compile)
+# ---------------------------------------------------------------------------
+
+
+def _storm_cfg() -> Config:
+    cfg = Config()
+    cfg.model.d_model = 32
+    cfg.model.n_layers = 2
+    cfg.model.n_heads = 4
+    cfg.model.vocab_size = 96
+    cfg.model.attn_impl = "xla"
+    cfg.model.compute_dtype = "float32"
+    cfg.model.max_seq_len = 64
+    cfg.photon.serve.n_slots = 2
+    cfg.photon.serve.block_size = 4
+    cfg.photon.serve.max_new_tokens = 4
+    cfg.photon.telemetry.enabled = True
+    apc = cfg.photon.telemetry.autopilot
+    apc.enabled = True
+    apc.period_s = 0.05
+    apc.cooldown_s = 0.1
+    apc.queue_high_frac = 0.3
+    apc.queue_clear_frac = 0.1
+    apc.prefill_budget_min = 4
+    apc.prefill_shrink = 0.5
+    cfg.photon.chaos = ChaosConfig(
+        enabled=True, seed=1234, serve_stall_per_token_s=0.002,
+    )
+    return cfg.validate()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_storm_autopilot_shrinks_budget_and_surfaces_decisions():
+    import time
+
+    from photon_tpu.models.mpt import init_params
+    from photon_tpu.serve.engine import PagedEngine
+    from photon_tpu.serve.scheduler import ContinuousBatcher
+
+    from photon_tpu.serve.frontend import ServeFrontend
+
+    cfg = _storm_cfg()
+    telemetry.install(cfg.photon.telemetry, scope="serve")
+    chaos.install(cfg.photon.chaos, scope="serve")
+    engine = PagedEngine(cfg, init_params(cfg.model, seed=4))
+    batcher = ContinuousBatcher(
+        engine, max_queue=8, prefill_token_budget=32,
+    ).start()
+    fe = ServeFrontend(batcher)
+    fe_port = fe.start()
+    ap = telemetry.autopilot_active()
+    assert ap is not None
+    z = ap.statusz()["knobs"][AUTOPILOT_KNOB_PREFILL_BUDGET]
+    assert z["declared"] == 32
+    try:
+        rng = np.random.default_rng(0)
+        handles = []
+        # fat prompts + per-token chaos stall: the queue EWMA saturates
+        # against queue_high_frac and the controller must shrink the
+        # budget (6 < max_queue=8 so admission itself never rejects)
+        for _ in range(6):
+            prompt = [int(x) for x in rng.integers(1, 96, 24)]
+            handles.append(batcher.submit(prompt, max_new_tokens=2))
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            done = all(h.finished for h in handles)
+            if done and batcher.prefill_token_budget < 32:
+                break
+            time.sleep(0.05)
+        assert batcher.rejected == 0  # the queue never overflowed
+        assert batcher.prefill_token_budget < 32
+        decisions = ap.statusz()["decisions"]
+        acts = [d for d in decisions
+                if d["event"] == EVENT_AUTOPILOT_ACTUATION
+                and d["knob"] == AUTOPILOT_KNOB_PREFILL_BUDGET]
+        assert acts, f"no budget actuation in {decisions}"
+        assert acts[0]["rule"] == "queue_budget"
+        assert acts[0]["old"] == 32
+        # chaos accounted its own storm
+        inj = chaos.active()
+        assert inj.counts["serve_stall"] > 0
+        # the decisions surface on the serve frontend's /statusz too
+        import json
+        import urllib.request
+
+        z = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{fe_port}/statusz", timeout=30).read())
+        assert any(d["event"] == EVENT_AUTOPILOT_ACTUATION
+                   for d in z["autopilot"]["decisions"])
+        assert z["autopilot"]["knobs"][AUTOPILOT_KNOB_PREFILL_BUDGET][
+            "value"] < 32
+    finally:
+        fe.close()
+        batcher.close()
